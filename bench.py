@@ -1,0 +1,94 @@
+"""Benchmark: HIGGS-like GBDT training throughput on the local accelerator.
+
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+
+Setup mirrors the reference's published benchmark config
+(docs/GPU-Performance.md:96-116 / BASELINE.md): max_bin=63, num_leaves=255,
+lr=0.1, min_data_in_leaf=1, min_sum_hessian_in_leaf=100, binary objective,
+dense ~28-feature data (HIGGS is 10.5M x 28; we bench a scaled-down slice
+sized for CI-time runs and report million-rows-processed/sec so the number
+is size-invariant).
+
+vs_baseline: the reference repo publishes no wall-clock numbers
+(BASELINE.md: chart is an external image), so the baseline constant below
+is the reference CPU implementation measured on this machine via
+scripts/measure_baseline.py (which builds /root/reference out-of-tree) and
+cached in BENCH_BASELINE.json; falls back to 1.0 (self-relative) if absent.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+N_ROWS = int(os.environ.get("BENCH_ROWS", 500_000))
+N_FEATURES = 28
+N_ITERS = int(os.environ.get("BENCH_ITERS", 20))
+NUM_LEAVES = 255
+MAX_BIN = 63
+
+
+def synth_higgs(n, f, seed=0):
+    """Synthetic HIGGS-like: dense float features, binary label from a
+    nonlinear score (matches HIGGS's structure: 28 kinematic features)."""
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f).astype(np.float32)
+    score = (X[:, 0] * 1.2 - X[:, 1] + 0.8 * X[:, 2] * X[:, 3]
+             + 0.5 * np.abs(X[:, 4]) + 0.3 * X[:, 5] ** 2)
+    y = (score + rng.logistic(size=n) > 0.5).astype(np.float32)
+    return X, y
+
+
+def main():
+    import lightgbm_tpu as lgb
+
+    X, y = synth_higgs(N_ROWS, N_FEATURES)
+    params = {
+        "objective": "binary", "metric": "auc", "verbose": -1,
+        "max_bin": MAX_BIN, "num_leaves": NUM_LEAVES,
+        "learning_rate": 0.1, "min_data_in_leaf": 1,
+        "min_sum_hessian_in_leaf": 100.0,
+    }
+    ds = lgb.Dataset(X, y)
+    ds.construct()
+
+    # warmup: compile the grower (first tree)
+    t0 = time.time()
+    warm = lgb.train(dict(params), ds, num_boost_round=1, verbose_eval=False)
+    compile_time = time.time() - t0
+
+    t0 = time.time()
+    booster = lgb.train(dict(params), ds, num_boost_round=N_ITERS,
+                        verbose_eval=False)
+    train_time = time.time() - t0
+
+    rows_per_sec = N_ROWS * N_ITERS / train_time
+    value = rows_per_sec / 1e6  # million row-iterations per second
+
+    baseline = None
+    base_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "BENCH_BASELINE.json")
+    if os.path.exists(base_path):
+        with open(base_path) as fh:
+            b = json.load(fh)
+            baseline = b.get("mrows_per_sec")
+    vs_baseline = (value / baseline) if baseline else 1.0
+
+    print(json.dumps({
+        "metric": "higgs_like_train_throughput",
+        "value": round(value, 4),
+        "unit": "mrow_iters/s",
+        "vs_baseline": round(vs_baseline, 4),
+        "detail": {
+            "rows": N_ROWS, "features": N_FEATURES, "iters": N_ITERS,
+            "num_leaves": NUM_LEAVES, "max_bin": MAX_BIN,
+            "train_seconds": round(train_time, 3),
+            "compile_seconds": round(compile_time, 3),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

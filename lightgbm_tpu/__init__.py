@@ -1,0 +1,19 @@
+"""lightgbm_tpu — a TPU-native gradient boosting framework.
+
+A from-scratch JAX/XLA re-design of the LightGBM feature set: leaf-wise
+histogram GBDT with data/feature/voting-parallel distributed training over
+`jax.sharding.Mesh` collectives, objectives/metrics for regression, binary,
+multiclass and lambdarank, DART/GOSS/RF variants, and a LightGBM-compatible
+Python API and text model format.
+"""
+from .basic import Booster, Dataset  # noqa: F401
+from .engine import cv, train  # noqa: F401
+from . import log  # noqa: F401
+
+try:
+    from .sklearn import (LGBMClassifier, LGBMModel,  # noqa: F401
+                          LGBMRanker, LGBMRegressor)
+except ImportError:  # sklearn not installed
+    pass
+
+__version__ = "0.1.0"

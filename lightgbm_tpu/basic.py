@@ -1,0 +1,451 @@
+"""Public Dataset / Booster API.
+
+Mirrors the reference python-package surface (`python-package/lightgbm/
+basic.py` — lazy `Dataset` at :548, `Booster` at :1223) directly over the
+TPU engine; there is no ctypes boundary because the "C API layer" of the
+reference (src/c_api.cpp) collapses into in-process Python + device calls.
+A C-compatible shim for external bindings lives in `lightgbm_tpu/capi.py`.
+"""
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from . import log
+from .boosting import create_boosting
+from .config import Config, key_alias_transform, params_str2map
+from .dataset import Dataset as _InnerDataset
+from .metrics import default_metric_for_objective
+from .objectives import create_objective
+
+LightGBMError = log.LightGBMError
+
+
+def _data_to_2d(data) -> np.ndarray:
+    if isinstance(data, str):
+        from .io.parser import load_data_file
+        arr, _ = load_data_file(data)
+        return arr
+    try:
+        import pandas as pd
+        if isinstance(data, pd.DataFrame):
+            return data.values.astype(np.float64)
+    except ImportError:
+        pass
+    try:
+        import scipy.sparse as sp
+        if sp.issparse(data):
+            return np.asarray(data.todense(), np.float64)
+    except ImportError:
+        pass
+    arr = np.asarray(data, np.float64)
+    if arr.ndim == 1:
+        arr = arr.reshape(1, -1)
+    return arr
+
+
+class Dataset:
+    """Lazy dataset wrapper (reference: basic.py:548-1222)."""
+
+    def __init__(self, data, label=None, max_bin: int = 255, reference: Optional["Dataset"] = None,
+                 weight=None, group=None, init_score=None, silent: bool = False,
+                 feature_name: Union[str, Sequence[str]] = "auto",
+                 categorical_feature: Union[str, Sequence] = "auto",
+                 params: Optional[Dict[str, Any]] = None, free_raw_data: bool = False):
+        self.data = data
+        self.label = label
+        self.max_bin = max_bin
+        self.reference = reference
+        self.weight = weight
+        self.group = group
+        self.init_score = init_score
+        self.params = dict(params or {})
+        self.feature_name = feature_name
+        self.categorical_feature = categorical_feature
+        self.free_raw_data = free_raw_data
+        self._inner: Optional[_InnerDataset] = None
+        self.used_indices: Optional[np.ndarray] = None
+        self._predictor = None
+
+    # ------------------------------------------------------------------
+    def _lazy_init(self) -> _InnerDataset:
+        if self._inner is not None:
+            return self._inner
+        params = key_alias_transform(self.params)
+        max_bin = int(params.get("max_bin", self.max_bin))
+        cfg = Config.from_params({k: v for k, v in params.items()
+                                  if k not in ("max_bin",)}) \
+            if False else None  # full config not needed for binning
+        data = self.data
+        if isinstance(data, str):
+            from .io.parser import load_data_file
+            arr, label = load_data_file(data)
+            if self.label is None and label is not None:
+                self.label = label
+            data = arr
+        else:
+            data = _data_to_2d(data)
+        if self.used_indices is not None:
+            data = data[self.used_indices]
+
+        feature_names = None
+        cat_indices: Optional[List[int]] = None
+        if self.feature_name != "auto" and self.feature_name is not None:
+            feature_names = list(self.feature_name)
+        try:
+            import pandas as pd
+            if isinstance(self.data, pd.DataFrame):
+                if feature_names is None:
+                    feature_names = [str(c) for c in self.data.columns]
+                if self.categorical_feature == "auto":
+                    cat_indices = [i for i, dt in enumerate(self.data.dtypes)
+                                   if str(dt) == "category"]
+        except ImportError:
+            pass
+        if isinstance(self.categorical_feature, (list, tuple)):
+            cat_indices = []
+            for c in self.categorical_feature:
+                if isinstance(c, str) and feature_names and c in feature_names:
+                    cat_indices.append(feature_names.index(c))
+                elif isinstance(c, int):
+                    cat_indices.append(c)
+
+        label = self.label
+        if label is not None:
+            label = np.asarray(label, np.float32).ravel()
+            if self.used_indices is not None:
+                label = label[self.used_indices]
+        weight = self.weight
+        if weight is not None and self.used_indices is not None:
+            weight = np.asarray(weight)[self.used_indices]
+        group = self.group
+        init_score = self.init_score
+        if init_score is not None and self.used_indices is not None:
+            init_score = np.asarray(init_score)[self.used_indices]
+
+        ref_inner = self.reference._lazy_init() if self.reference is not None else None
+        self._inner = _InnerDataset.from_numpy(
+            data, label=label, max_bin=max_bin,
+            min_data_in_bin=int(params.get("min_data_in_bin", 3)),
+            bin_construct_sample_cnt=int(params.get("bin_construct_sample_cnt", 200000)),
+            data_random_seed=int(params.get("data_random_seed", 1)),
+            categorical_features=cat_indices,
+            use_missing=bool(params.get("use_missing", True)),
+            zero_as_missing=bool(params.get("zero_as_missing", False)),
+            feature_names=feature_names,
+            weight=weight, group=group, init_score=init_score,
+            reference=ref_inner, keep_raw=not self.free_raw_data)
+        return self._inner
+
+    def construct(self) -> "Dataset":
+        self._lazy_init()
+        return self
+
+    # ------------------------------------------------------------------
+    def create_valid(self, data, label=None, weight=None, group=None,
+                     init_score=None, silent: bool = False,
+                     params: Optional[dict] = None) -> "Dataset":
+        """Reference: basic.py Dataset.create_valid."""
+        return Dataset(data, label=label, reference=self, weight=weight,
+                       group=group, init_score=init_score, silent=silent,
+                       params=params or self.params)
+
+    def set_reference(self, reference: "Dataset") -> "Dataset":
+        self.reference = reference
+        self._inner = None
+        return self
+
+    def subset(self, used_indices, params: Optional[dict] = None) -> "Dataset":
+        """Reference: basic.py Dataset.subset (used by cv)."""
+        ds = Dataset(self.data, label=self.label, max_bin=self.max_bin,
+                     reference=self.reference or self, weight=self.weight,
+                     group=None, init_score=None,
+                     feature_name=self.feature_name,
+                     categorical_feature=self.categorical_feature,
+                     params=params or self.params)
+        ds.used_indices = np.asarray(sorted(used_indices))
+        if self.group is not None:
+            log.warning("subset() with query data drops group info; "
+                        "regroup manually for ranking cv")
+        return ds
+
+    # ------------------------------------------------------------------
+    def set_label(self, label) -> "Dataset":
+        self.label = label
+        if self._inner is not None:
+            self._inner.metadata.set_label(np.asarray(label, np.float32).ravel())
+        return self
+
+    def set_weight(self, weight) -> "Dataset":
+        self.weight = weight
+        if self._inner is not None:
+            self._inner.metadata.set_weights(weight)
+        return self
+
+    def set_group(self, group) -> "Dataset":
+        self.group = group
+        if self._inner is not None:
+            self._inner.metadata.set_group(group)
+        return self
+
+    def set_init_score(self, init_score) -> "Dataset":
+        self.init_score = init_score
+        if self._inner is not None:
+            self._inner.metadata.set_init_score(init_score)
+        return self
+
+    def get_label(self):
+        if self._inner is not None and self._inner.metadata.label is not None:
+            return self._inner.metadata.label
+        return self.label
+
+    def get_weight(self):
+        return self.weight
+
+    def get_group(self):
+        return self.group
+
+    def get_init_score(self):
+        return self.init_score
+
+    def num_data(self) -> int:
+        return self._lazy_init().num_data
+
+    def num_feature(self) -> int:
+        return self._lazy_init().num_total_features
+
+    def save_binary(self, filename: str) -> "Dataset":
+        self._lazy_init().save_binary(filename)
+        return self
+
+    def get_field(self, name: str):
+        inner = self._lazy_init()
+        if name == "label":
+            return inner.metadata.label
+        if name == "weight":
+            return inner.metadata.weights
+        if name == "group":
+            qb = inner.metadata.query_boundaries
+            return None if qb is None else np.diff(qb)
+        if name == "init_score":
+            return inner.metadata.init_score
+        raise LightGBMError(f"Unknown field {name}")
+
+    def set_field(self, name: str, data) -> None:
+        inner = self._lazy_init()
+        if name == "label":
+            inner.metadata.set_label(data)
+        elif name == "weight":
+            inner.metadata.set_weights(data)
+        elif name == "group":
+            inner.metadata.set_group(data)
+        elif name == "init_score":
+            inner.metadata.set_init_score(data)
+        else:
+            raise LightGBMError(f"Unknown field {name}")
+
+
+class Booster:
+    """Reference: basic.py:1223+ over c_api Booster (c_api.cpp:28-308)."""
+
+    def __init__(self, params: Optional[dict] = None, train_set: Optional[Dataset] = None,
+                 model_file: Optional[str] = None, model_str: Optional[str] = None,
+                 silent: bool = False):
+        self.params = dict(params or {})
+        self.train_set = train_set
+        self._valid_sets: List[Dataset] = []
+        self.name_valid_sets: List[str] = []
+        self.best_iteration = -1
+        self.best_score: Dict = {}
+
+        if train_set is not None:
+            cfg = Config.from_params(self.params)
+            self.config = cfg
+            inner_train = train_set._lazy_init()
+            objective = create_objective(cfg)
+            self._inner = create_boosting(cfg.boosting_type, cfg)
+            metric_names = cfg.metric.metric_types or \
+                [default_metric_for_objective(cfg.objective)]
+            self._metric_names = metric_names
+            self._inner.init(inner_train, objective, metric_names)
+        elif model_file is not None:
+            with open(model_file) as fh:
+                text = fh.read()
+            self._from_string(text)
+        elif model_str is not None:
+            self._from_string(model_str)
+        else:
+            raise LightGBMError("Booster needs train_set, model_file or model_str")
+
+    def _from_string(self, text: str) -> None:
+        first = text.strip().splitlines()[0].strip()
+        boosting_type = {"tree": "gbdt", "gbdt": "gbdt", "dart": "dart",
+                         "goss": "goss"}.get(first, "gbdt")
+        params = dict(self.params)
+        # objective from model text so convert_output works
+        for line in text.splitlines()[:20]:
+            if line.startswith("objective="):
+                obj = line.split("=", 1)[1].split()
+                params.setdefault("objective", obj[0])
+                for tok in obj[1:]:
+                    if ":" in tok:
+                        k, v = tok.split(":", 1)
+                        params.setdefault(k, v)
+        cfg = Config.from_params(params)
+        self.config = cfg
+        self._inner = create_boosting(boosting_type, cfg)
+        self._inner.load_model_from_string(text)
+        if "objective" in params:
+            self._inner.objective = create_objective(cfg)
+        self._metric_names = []
+
+    # ------------------------------------------------------------------
+    def add_valid(self, data: Dataset, name: str) -> "Booster":
+        if data.reference is None and self.train_set is not None:
+            data.set_reference(self.train_set)
+        inner = data._lazy_init()
+        self._valid_sets.append(data)
+        self.name_valid_sets.append(name)
+        self._inner.add_valid(inner, name, self._metric_names)
+        return self
+
+    def update(self, train_set=None, fobj=None) -> bool:
+        """One boosting iteration; returns True if no further splits
+        (reference: basic.py Booster.update -> LGBM_BoosterUpdateOneIter)."""
+        if fobj is None:
+            return self._inner.train_one_iter()
+        grad, hess = fobj(self.__pred_for_fobj(), self.train_set)
+        return self.__boost(grad, hess)
+
+    def __pred_for_fobj(self):
+        return self._inner._train_score_unpadded()
+
+    def __boost(self, grad, hess) -> bool:
+        grad = np.asarray(grad, np.float32)
+        hess = np.asarray(hess, np.float32)
+        k = self._inner.num_tree_per_iteration
+        n = self._inner._n
+        if grad.size != n * k:
+            raise LightGBMError(
+                f"Lengths of gradients ({grad.size}) doesn't equal "
+                f"num_data*num_class ({n * k})")
+        n_pad = self._inner._n_pad
+        g = np.zeros((k, n_pad), np.float32)
+        h = np.zeros((k, n_pad), np.float32)
+        g[:, :n] = grad.reshape(k, n)
+        h[:, :n] = hess.reshape(k, n)
+        return self._inner.train_one_iter(g, h)
+
+    def rollback_one_iter(self) -> "Booster":
+        self._inner.rollback_one_iter()
+        return self
+
+    def current_iteration(self) -> int:
+        return self._inner.current_iteration()
+
+    def num_trees(self) -> int:
+        return self._inner.num_trees()
+
+    # ------------------------------------------------------------------
+    def eval_train(self, feval=None) -> List:
+        return self.__inner_eval("training", -1, feval)
+
+    def eval_valid(self, feval=None) -> List:
+        out = []
+        for i in range(len(self._valid_sets)):
+            out.extend(self.__inner_eval(self.name_valid_sets[i], i, feval))
+        return out
+
+    def eval(self, data: Dataset, name: str, feval=None) -> List:
+        for i, v in enumerate(self._valid_sets):
+            if v is data:
+                return self.__inner_eval(name, i, feval)
+        self.add_valid(data, name)
+        return self.__inner_eval(name, len(self._valid_sets) - 1, feval)
+
+    def __inner_eval(self, name: str, idx: int, feval=None) -> List:
+        out = []
+        if idx < 0:
+            if self._inner.metrics:
+                score = self._inner._train_score_unpadded()
+                for m in self._inner.metrics:
+                    for mname, val in m.eval(score, self._inner.objective):
+                        out.append((name, mname, val, m.is_bigger_better))
+        else:
+            score = np.asarray(self._inner._valid_score[idx], np.float64).reshape(-1)
+            for m in self._inner.valid_metrics[idx]:
+                for mname, val in m.eval(score, self._inner.objective):
+                    out.append((name, mname, val, m.is_bigger_better))
+        if feval is not None:
+            ds = self.train_set if idx < 0 else self._valid_sets[idx]
+            if idx < 0:
+                preds = self._inner._train_score_unpadded()
+            else:
+                preds = np.asarray(self._inner._valid_score[idx], np.float64).reshape(-1)
+            ret = feval(preds, ds)
+            if isinstance(ret, list):
+                for mname, val, bigger in ret:
+                    out.append((name, mname, val, bigger))
+            else:
+                mname, val, bigger = ret
+                out.append((name, mname, val, bigger))
+        return out
+
+    # ------------------------------------------------------------------
+    def predict(self, data, num_iteration: int = -1, raw_score: bool = False,
+                pred_leaf: bool = False, pred_contrib: bool = False,
+                data_has_header: bool = False, is_reshape: bool = True):
+        arr = _data_to_2d(data)
+        return self._inner.predict(arr, num_iteration, raw_score, pred_leaf,
+                                   pred_contrib)
+
+    # ------------------------------------------------------------------
+    def save_model(self, filename: str, num_iteration: int = -1) -> "Booster":
+        self._inner.save_model(filename, num_iteration)
+        return self
+
+    def model_to_string(self, num_iteration: int = -1) -> str:
+        return self._inner.save_model_to_string(num_iteration)
+
+    def dump_model(self, num_iteration: int = -1) -> dict:
+        return self._inner.dump_model(num_iteration)
+
+    def feature_importance(self, importance_type: str = "split",
+                           iteration: int = -1) -> np.ndarray:
+        return self._inner.feature_importance(importance_type, iteration)
+
+    def feature_name(self) -> List[str]:
+        return list(self._inner.feature_names)
+
+    def num_feature(self) -> int:
+        return self._inner.max_feature_idx + 1
+
+    def num_model_per_iteration(self) -> int:
+        return self._inner.num_tree_per_iteration
+
+    # pickling support (reference: test_engine.py:382 pickling tests)
+    def __getstate__(self):
+        state = {"params": self.params,
+                 "model_str": self.model_to_string(),
+                 "best_iteration": self.best_iteration,
+                 "best_score": self.best_score}
+        return state
+
+    def __setstate__(self, state):
+        self.params = state["params"]
+        self.train_set = None
+        self._valid_sets = []
+        self.name_valid_sets = []
+        self.best_iteration = state.get("best_iteration", -1)
+        self.best_score = state.get("best_score", {})
+        self._from_string(state["model_str"])
+
+    def __copy__(self):
+        return self.__deepcopy__(None)
+
+    def __deepcopy__(self, _):
+        b = Booster(params=self.params, model_str=self.model_to_string())
+        b.best_iteration = self.best_iteration
+        return b

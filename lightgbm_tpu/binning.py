@@ -1,0 +1,419 @@
+"""Per-feature value -> bin discretization (host side, numpy).
+
+Behavioral re-implementation of the reference BinMapper
+(`include/LightGBM/bin.h:60-208`, `src/io/bin.cpp:70-330`):
+
+- numerical features: greedy equal-count binning over sampled distinct
+  values (`GreedyFindBin`, bin.cpp:70-140), with zero always given its own
+  bin (`FindBinWithZeroAsOneBin`, bin.cpp:141-198);
+- missing handling: MissingType None / Zero / NaN (bin.h:20-24); the NaN
+  bin, when present, is the LAST bin (bin.cpp:270-274);
+- categorical features: most-frequent-first bin assignment covering 99% of
+  mass, negatives -> NaN (bin.cpp:292-330);
+- `default_bin` is the bin of value 0.0 (bin.cpp:331-340); histograms on
+  device are built complete, so the reference's sparse default-bin-skip +
+  `FixHistogram` reconstruction (dataset.cpp:747-767) is unnecessary here.
+
+The binned matrix produced from these mappers is the HBM-resident tensor
+all device kernels operate on.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from . import log
+
+# Missing types (reference: bin.h:20-24)
+MISSING_NONE = 0
+MISSING_ZERO = 1
+MISSING_NAN = 2
+
+BIN_NUMERICAL = 0
+BIN_CATEGORICAL = 1
+
+# Reference: kZeroAsMissingValueRange / kZeroThreshold analogue (bin.h:15-18)
+K_ZERO_RANGE = 1e-35
+K_SPARSE_THRESHOLD_DEFAULT = 0.8
+
+
+def _greedy_find_bin(distinct_values: np.ndarray, counts: np.ndarray,
+                     max_bin: int, total_cnt: int, min_data_in_bin: int) -> List[float]:
+    """Equal-count greedy binning (reference: GreedyFindBin, bin.cpp:70-140).
+
+    Returns bin upper bounds; last bound is +inf.
+    """
+    num_distinct = len(distinct_values)
+    bin_upper_bound: List[float] = []
+    if max_bin <= 0:
+        log.fatal("max_bin must be > 0")
+    if num_distinct <= max_bin:
+        cur_cnt = 0
+        for i in range(num_distinct - 1):
+            cur_cnt += int(counts[i])
+            if cur_cnt >= min_data_in_bin:
+                bin_upper_bound.append((distinct_values[i] + distinct_values[i + 1]) / 2.0)
+                cur_cnt = 0
+        bin_upper_bound.append(np.inf)
+        return bin_upper_bound
+
+    if min_data_in_bin > 0:
+        max_bin = max(1, min(max_bin, total_cnt // min_data_in_bin))
+    mean_bin_size = total_cnt / max_bin
+    # values with very large counts get dedicated bins
+    is_big = counts >= mean_bin_size
+    rest_bin_cnt = max_bin - int(is_big.sum())
+    rest_sample_cnt = total_cnt - int(counts[is_big].sum())
+    mean_bin_size = rest_sample_cnt / max(rest_bin_cnt, 1)
+
+    upper_bounds = [np.inf] * max_bin
+    lower_bounds = [np.inf] * max_bin
+    bin_cnt = 0
+    lower_bounds[0] = distinct_values[0]
+    cur_cnt = 0
+    for i in range(num_distinct - 1):
+        if not is_big[i]:
+            rest_sample_cnt -= int(counts[i])
+        cur_cnt += int(counts[i])
+        if (is_big[i] or cur_cnt >= mean_bin_size or
+                (is_big[i + 1] and cur_cnt >= max(1.0, mean_bin_size * 0.5))):
+            upper_bounds[bin_cnt] = distinct_values[i]
+            bin_cnt += 1
+            lower_bounds[bin_cnt] = distinct_values[i + 1]
+            if bin_cnt >= max_bin - 1:
+                break
+            cur_cnt = 0
+            if not is_big[i]:
+                rest_bin_cnt -= 1
+                mean_bin_size = rest_sample_cnt / max(rest_bin_cnt, 1)
+    bin_cnt += 1
+    out = []
+    for i in range(bin_cnt - 1):
+        out.append((upper_bounds[i] + lower_bounds[i + 1]) / 2.0)
+    out.append(np.inf)
+    return out
+
+
+def _find_bin_with_zero_as_one_bin(distinct_values: np.ndarray, counts: np.ndarray,
+                                   max_bin: int, total_sample_cnt: int,
+                                   min_data_in_bin: int) -> List[float]:
+    """Zero always gets a dedicated bin (reference: bin.cpp:141-198)."""
+    left_mask = distinct_values <= -K_ZERO_RANGE
+    right_mask = distinct_values > K_ZERO_RANGE
+    zero_mask = ~left_mask & ~right_mask
+    left_cnt_data = int(counts[left_mask].sum())
+    cnt_zero = int(counts[zero_mask].sum())
+    right_cnt_data = int(counts[right_mask].sum())
+
+    left_cnt = int(np.argmax(distinct_values > -K_ZERO_RANGE)) \
+        if (distinct_values > -K_ZERO_RANGE).any() else len(distinct_values)
+
+    bin_upper_bound: List[float] = []
+    if left_cnt > 0:
+        denom = max(total_sample_cnt - cnt_zero, 1)
+        left_max_bin = max(1, int(left_cnt_data / denom * (max_bin - 1)))
+        bin_upper_bound = _greedy_find_bin(
+            distinct_values[:left_cnt], counts[:left_cnt],
+            left_max_bin, left_cnt_data, min_data_in_bin)
+        bin_upper_bound[-1] = -K_ZERO_RANGE
+
+    right_start = -1
+    for i in range(left_cnt, len(distinct_values)):
+        if distinct_values[i] > K_ZERO_RANGE:
+            right_start = i
+            break
+
+    if right_start >= 0:
+        right_max_bin = max_bin - 1 - len(bin_upper_bound)
+        if right_max_bin <= 0:
+            log.fatal("max_bin too small for zero-as-one-bin split")
+        right_bounds = _greedy_find_bin(
+            distinct_values[right_start:], counts[right_start:],
+            right_max_bin, right_cnt_data, min_data_in_bin)
+        bin_upper_bound.append(K_ZERO_RANGE)
+        bin_upper_bound.extend(right_bounds)
+    else:
+        bin_upper_bound.append(np.inf)
+    return bin_upper_bound
+
+
+class BinMapper:
+    """One feature's value->bin mapping (reference: BinMapper, bin.h:60-208)."""
+
+    def __init__(self):
+        self.num_bin: int = 1
+        self.missing_type: int = MISSING_NONE
+        self.is_trivial: bool = False
+        self.sparse_rate: float = 0.0
+        self.bin_type: int = BIN_NUMERICAL
+        self.bin_upper_bound: np.ndarray = np.array([np.inf])
+        self.bin_2_categorical: List[int] = []
+        self.categorical_2_bin: Dict[int, int] = {}
+        self.min_val: float = 0.0
+        self.max_val: float = 0.0
+        self.default_bin: int = 0  # bin of value 0.0
+
+    # ------------------------------------------------------------------
+    def find_bin(self, values: np.ndarray, total_sample_cnt: int, max_bin: int,
+                 min_data_in_bin: int = 3, min_split_data: int = 0,
+                 bin_type: int = BIN_NUMERICAL, use_missing: bool = True,
+                 zero_as_missing: bool = False) -> None:
+        """Construct the mapping from sampled values
+        (reference: BinMapper::FindBin, bin.cpp:200-330).
+
+        `values` are the sampled non-zero values; zeros are implied by
+        `total_sample_cnt - len(values)` as in the reference's sparse
+        sampling contract.
+        """
+        values = np.asarray(values, dtype=np.float64)
+        na_mask = np.isnan(values)
+        na_cnt = int(na_mask.sum())
+        values = values[~na_mask]
+        num_sample_values = len(values) + na_cnt
+
+        if not use_missing:
+            self.missing_type = MISSING_NONE
+        elif zero_as_missing:
+            self.missing_type = MISSING_ZERO
+        else:
+            self.missing_type = MISSING_NAN if na_cnt > 0 else MISSING_NONE
+
+        self.bin_type = bin_type
+        self.default_bin = 0
+        zero_cnt = int(total_sample_cnt - num_sample_values)
+
+        # distinct values with zero spliced in at its sorted position
+        values = np.sort(values)
+        distinct, counts = _distinct_with_zero(values, zero_cnt)
+        if len(distinct) == 0:
+            distinct = np.array([0.0])
+            counts = np.array([max(zero_cnt, 1)])
+        self.min_val = float(distinct[0])
+        self.max_val = float(distinct[-1])
+
+        if bin_type == BIN_NUMERICAL:
+            if self.missing_type == MISSING_ZERO:
+                bounds = _find_bin_with_zero_as_one_bin(
+                    distinct, counts, max_bin, total_sample_cnt, min_data_in_bin)
+                if len(bounds) == 2:
+                    self.missing_type = MISSING_NONE
+            elif self.missing_type == MISSING_NONE:
+                bounds = _find_bin_with_zero_as_one_bin(
+                    distinct, counts, max_bin, total_sample_cnt, min_data_in_bin)
+            else:  # NaN: reserve the last bin for NaN (bin.cpp:270-274)
+                bounds = _find_bin_with_zero_as_one_bin(
+                    distinct, counts, max_bin - 1, total_sample_cnt - na_cnt,
+                    min_data_in_bin)
+                bounds.append(np.nan)
+            self.bin_upper_bound = np.asarray(bounds, dtype=np.float64)
+            self.num_bin = len(bounds)
+            cnt_in_bin = self._count_in_bins(distinct, counts, na_cnt)
+        else:
+            # categorical: ints sorted by count desc, keep 99% mass
+            # (reference: bin.cpp:292-330)
+            distinct_int: Dict[int, int] = {}
+            for v, c in zip(distinct, counts):
+                iv = int(v)
+                distinct_int[iv] = distinct_int.get(iv, 0) + int(c)
+            items = sorted(distinct_int.items(), key=lambda kv: -kv[1])
+            # avoid first bin being the zero category (bin.cpp:306-310)
+            if len(items) > 1 and items[0][0] == 0:
+                items[0], items[1] = items[1], items[0]
+            cut_cnt = int((total_sample_cnt - na_cnt) * 0.99)
+            self.bin_2_categorical = []
+            self.categorical_2_bin = {}
+            self.num_bin = 0
+            used_cnt = 0
+            eff_max_bin = min(len(items), max_bin)
+            cnt_in_bin_list: List[int] = []
+            for cat, c in items:
+                if not (used_cnt < cut_cnt or self.num_bin < eff_max_bin):
+                    break
+                if cat < 0:
+                    na_cnt += c
+                    cut_cnt -= c
+                    log.warning("Met negative value in categorical features, "
+                                "will convert it to NaN")
+                    continue
+                self.bin_2_categorical.append(cat)
+                self.categorical_2_bin[cat] = self.num_bin
+                cnt_in_bin_list.append(c)
+                used_cnt += c
+                self.num_bin += 1
+            # rare categories fall into the NaN/other handling
+            if na_cnt > 0 or used_cnt < total_sample_cnt:
+                self.missing_type = MISSING_NAN
+            else:
+                self.missing_type = MISSING_NONE
+            cnt_in_bin = np.asarray(cnt_in_bin_list, dtype=np.int64)
+            if self.num_bin == 0:
+                self.num_bin = 1
+                self.bin_2_categorical = [0]
+                self.categorical_2_bin = {0: 0}
+                cnt_in_bin = np.array([total_sample_cnt], dtype=np.int64)
+
+        # trivial feature: only one populated bin (a constant nonzero column
+        # still gets a synthetic empty zero bin from zero-as-one-bin)
+        self.is_trivial = self.num_bin <= 1 or int((cnt_in_bin > 0).sum()) <= 1
+        if bin_type == BIN_NUMERICAL:
+            self.default_bin = self.value_to_bin(0.0)
+        else:
+            self.default_bin = self.categorical_2_bin.get(0, 0)
+        if len(cnt_in_bin) > 0 and total_sample_cnt > 0:
+            nz = int(cnt_in_bin[self.default_bin]) if self.default_bin < len(cnt_in_bin) else 0
+            self.sparse_rate = nz / float(total_sample_cnt)
+        # a numerical feature whose non-default mass can't satisfy
+        # min_split_data on both sides is trivial (reference: NeedFilter)
+        if (min_split_data > 0 and bin_type == BIN_NUMERICAL
+                and not self.is_trivial):
+            csum = np.cumsum(cnt_in_bin[:-1]) if len(cnt_in_bin) > 1 else np.array([])
+            total = int(cnt_in_bin.sum())
+            ok = np.any((csum >= min_split_data) & (total - csum >= min_split_data)) \
+                if len(csum) else False
+            if not ok:
+                self.is_trivial = True
+
+    def _count_in_bins(self, distinct: np.ndarray, counts: np.ndarray,
+                       na_cnt: int) -> np.ndarray:
+        cnt = np.zeros(self.num_bin, dtype=np.int64)
+        finite_bounds = self.bin_upper_bound.copy()
+        finite_bounds[np.isnan(finite_bounds)] = np.inf
+        idx = np.searchsorted(finite_bounds, distinct, side="left")
+        # searchsorted('left') gives first bound >= v, matching v <= bound
+        np.add.at(cnt, np.minimum(idx, self.num_bin - 1), counts)
+        if self.missing_type == MISSING_NAN:
+            cnt[self.num_bin - 1] = na_cnt
+        return cnt
+
+    # ------------------------------------------------------------------
+    def value_to_bin(self, value: float) -> int:
+        """Reference: BinMapper::ValueToBin, bin.h:451-487 (binary search on
+        upper bounds; NaN -> last bin when missing_type is NaN; zero-as-missing
+        maps |v|<=eps to the default zero bin)."""
+        if self.bin_type == BIN_CATEGORICAL:
+            iv = int(value) if not np.isnan(value) else -1
+            if iv < 0:
+                return self.num_bin - 1
+            return self.categorical_2_bin.get(iv, self.num_bin - 1)
+        if np.isnan(value):
+            if self.missing_type == MISSING_NAN:
+                return self.num_bin - 1
+            value = 0.0
+        n_num = self.num_bin - (1 if self.missing_type == MISSING_NAN else 0)
+        bounds = self.bin_upper_bound[:n_num]
+        return int(np.searchsorted(bounds, value, side="left").clip(0, n_num - 1))
+
+    def values_to_bins(self, values: np.ndarray) -> np.ndarray:
+        """Vectorized ValueToBin over a column."""
+        values = np.asarray(values, dtype=np.float64)
+        out = np.zeros(len(values), dtype=np.int32)
+        if self.bin_type == BIN_CATEGORICAL:
+            nan_bin = self.num_bin - 1
+            lut_keys = np.asarray(list(self.categorical_2_bin.keys()), dtype=np.int64)
+            lut_vals = np.asarray(list(self.categorical_2_bin.values()), dtype=np.int64)
+            iv = np.where(np.isnan(values), -1, values).astype(np.int64)
+            out[:] = nan_bin
+            if len(lut_keys):
+                order = np.argsort(lut_keys)
+                lut_keys, lut_vals = lut_keys[order], lut_vals[order]
+                pos = np.searchsorted(lut_keys, iv)
+                pos_c = np.clip(pos, 0, len(lut_keys) - 1)
+                hit = (lut_keys[pos_c] == iv) & (iv >= 0)
+                out[hit] = lut_vals[pos_c[hit]]
+            return out
+        nan_mask = np.isnan(values)
+        n_num = self.num_bin - (1 if self.missing_type == MISSING_NAN else 0)
+        bounds = self.bin_upper_bound[:n_num]
+        vals = np.where(nan_mask, 0.0, values)
+        out = np.searchsorted(bounds, vals, side="left").clip(0, n_num - 1).astype(np.int32)
+        if self.missing_type == MISSING_NAN:
+            out[nan_mask] = self.num_bin - 1
+        return out
+
+    def bin_to_value(self, bin_idx: int) -> float:
+        """Reference: BinMapper::BinToValue (model thresholds use upper bounds)."""
+        if self.bin_type == BIN_CATEGORICAL:
+            return float(self.bin_2_categorical[bin_idx]) \
+                if bin_idx < len(self.bin_2_categorical) else -1.0
+        return float(self.bin_upper_bound[bin_idx])
+
+    # ------------------------------------------------------------------
+    def to_dict(self) -> dict:
+        return {
+            "num_bin": self.num_bin,
+            "missing_type": self.missing_type,
+            "is_trivial": self.is_trivial,
+            "sparse_rate": self.sparse_rate,
+            "bin_type": self.bin_type,
+            "bin_upper_bound": [float(x) for x in self.bin_upper_bound],
+            "bin_2_categorical": list(self.bin_2_categorical),
+            "min_val": self.min_val,
+            "max_val": self.max_val,
+            "default_bin": self.default_bin,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "BinMapper":
+        m = cls()
+        m.num_bin = int(d["num_bin"])
+        m.missing_type = int(d["missing_type"])
+        m.is_trivial = bool(d["is_trivial"])
+        m.sparse_rate = float(d["sparse_rate"])
+        m.bin_type = int(d["bin_type"])
+        m.bin_upper_bound = np.asarray(d["bin_upper_bound"], dtype=np.float64)
+        m.bin_2_categorical = [int(x) for x in d["bin_2_categorical"]]
+        m.categorical_2_bin = {c: i for i, c in enumerate(m.bin_2_categorical)}
+        m.min_val = float(d["min_val"])
+        m.max_val = float(d["max_val"])
+        m.default_bin = int(d["default_bin"])
+        return m
+
+
+def _distinct_with_zero(sorted_values: np.ndarray, zero_cnt: int):
+    """Distinct values + counts with an implied zero block spliced in
+    (reference: bin.cpp:230-262)."""
+    if len(sorted_values) == 0:
+        if zero_cnt > 0:
+            return np.array([0.0]), np.array([zero_cnt], dtype=np.int64)
+        return np.array([]), np.array([], dtype=np.int64)
+    distinct, counts = np.unique(sorted_values, return_counts=True)
+    if zero_cnt > 0 and not np.any(distinct == 0.0):
+        pos = int(np.searchsorted(distinct, 0.0))
+        distinct = np.insert(distinct, pos, 0.0)
+        counts = np.insert(counts, pos, zero_cnt)
+    elif zero_cnt > 0:
+        counts = counts.copy()
+        counts[distinct == 0.0] += zero_cnt
+    return distinct, counts.astype(np.int64)
+
+
+def find_bin_mappers(data: np.ndarray, max_bin: int, min_data_in_bin: int = 3,
+                     min_split_data: int = 0,
+                     sample_cnt: int = 200000, seed: int = 1,
+                     categorical_features: Optional[Sequence[int]] = None,
+                     use_missing: bool = True,
+                     zero_as_missing: bool = False) -> List[BinMapper]:
+    """Build per-feature BinMappers from a row-sampled slice of the data
+    (reference: DatasetLoader::ConstructBinMappersFromTextData,
+    dataset_loader.cpp:666-817 — sampling via `bin_construct_sample_cnt`)."""
+    n, f = data.shape
+    rng = np.random.RandomState(seed)
+    if n > sample_cnt:
+        idx = rng.choice(n, size=sample_cnt, replace=False)
+        sample = data[np.sort(idx)]
+        total = sample_cnt
+    else:
+        sample = data
+        total = n
+    cats = set(categorical_features or [])
+    mappers = []
+    for j in range(f):
+        col = np.asarray(sample[:, j], dtype=np.float64)
+        m = BinMapper()
+        nonzero = col[(col != 0.0) | np.isnan(col)]
+        m.find_bin(nonzero, total, max_bin, min_data_in_bin, min_split_data,
+                   BIN_CATEGORICAL if j in cats else BIN_NUMERICAL,
+                   use_missing, zero_as_missing)
+        mappers.append(m)
+    return mappers

@@ -1,0 +1,19 @@
+from .gbdt import GBDT
+from .dart import DART
+from .goss import GOSS
+from .rf import RF
+
+from .. import log
+
+
+def create_boosting(boosting_type: str, config):
+    """Factory (reference: Boosting::CreateBoosting, boosting.cpp:29-76)."""
+    if boosting_type == "gbdt":
+        return GBDT(config)
+    if boosting_type == "dart":
+        return DART(config)
+    if boosting_type == "goss":
+        return GOSS(config)
+    if boosting_type in ("rf", "random_forest"):
+        return RF(config)
+    log.fatal("Unknown boosting type %s" % boosting_type)

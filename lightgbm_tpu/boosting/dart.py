@@ -1,0 +1,132 @@
+"""DART: Dropouts meet Multiple Additive Regression Trees.
+
+Reference: `src/boosting/dart.hpp` — per iteration a random subset of
+existing trees is dropped (weight-proportional unless uniform_drop), the
+new tree is fit against the score without them, and dropped trees are
+re-weighted to k/(k+1) (or the xgboost_dart_mode variant) so the ensemble
+stays normalized (DroppingTrees dart.hpp:85-130, Normalize :140-180).
+"""
+from __future__ import annotations
+
+import copy
+from typing import List
+
+import numpy as np
+
+from .gbdt import GBDT
+from ..ops.predict import predict_value_binned
+
+
+class DART(GBDT):
+    def __init__(self, config):
+        super().__init__(config)
+        self.tree_weight: List[float] = []
+        self.sum_weight = 0.0
+        self._drop_rng = np.random.RandomState(config.boosting.drop_seed)
+        self.drop_index: List[int] = []
+
+    def model_name(self) -> str:
+        return "dart"
+
+    def _tree_contribution(self, it: int, sign: float, on_valid: bool):
+        """Add sign * tree(it) to train (and optionally valid) scores."""
+        import jax.numpy as jnp
+        k = self.num_tree_per_iteration
+        for cls in range(k):
+            tree = self.models[it * k + cls]
+            if tree.num_leaves <= 1:
+                continue
+            t = copy.deepcopy(tree)
+            t.leaf_value = t.leaf_value * sign
+            dt = t.to_device()
+            if not on_valid:
+                self._score = self._score.at[cls].add(
+                    predict_value_binned(dt, self._binned))
+            else:
+                for vi in range(len(self.valid_sets)):
+                    self._valid_score[vi] = self._valid_score[vi].at[cls].add(
+                        predict_value_binned(dt, self._valid_binned[vi]))
+
+    def _dropping_trees(self):
+        """Select and remove dropped trees from the train score
+        (dart.hpp:85-130)."""
+        cfg = self.config.boosting
+        self.drop_index = []
+        if self._drop_rng.rand() >= cfg.skip_drop:
+            drop_rate = cfg.drop_rate
+            if not cfg.uniform_drop:
+                inv_avg = len(self.tree_weight) / self.sum_weight \
+                    if self.sum_weight > 0 else 0.0
+                if cfg.max_drop > 0 and self.sum_weight > 0:
+                    drop_rate = min(drop_rate, cfg.max_drop * inv_avg / self.sum_weight)
+                for i in range(self.iter_):
+                    if self._drop_rng.rand() < drop_rate * self.tree_weight[i] * inv_avg:
+                        self.drop_index.append(i)
+            else:
+                if cfg.max_drop > 0 and self.iter_ > 0:
+                    drop_rate = min(drop_rate, cfg.max_drop / self.iter_)
+                for i in range(self.iter_):
+                    if self._drop_rng.rand() < drop_rate:
+                        self.drop_index.append(i)
+        for i in self.drop_index:
+            self._tree_contribution(i, -1.0, on_valid=False)
+        kdrop = len(self.drop_index)
+        if not cfg.xgboost_dart_mode:
+            self.shrinkage_rate = cfg.learning_rate / (1.0 + kdrop)
+        else:
+            self.shrinkage_rate = cfg.learning_rate if kdrop == 0 else \
+                cfg.learning_rate / (cfg.learning_rate + kdrop)
+
+    def _normalize(self):
+        """Re-weight dropped trees (dart.hpp:140-180)."""
+        cfg = self.config.boosting
+        kdrop = float(len(self.drop_index))
+        for i in self.drop_index:
+            if not cfg.xgboost_dart_mode:
+                factor = kdrop / (kdrop + 1.0)
+            else:
+                factor = kdrop / (kdrop + cfg.learning_rate)
+            # valid scores still hold the full tree: adjust by (factor-1)
+            k = self.num_tree_per_iteration
+            for cls in range(k):
+                tree = self.models[i * k + cls]
+                tree.leaf_value = tree.leaf_value * factor
+                tree.internal_value = tree.internal_value * factor
+            self._tree_contribution_scaled(i, (factor - 1.0) / factor, on_valid=True)
+            # train score had the tree fully removed: add back factor*tree
+            self._tree_contribution(i, 1.0, on_valid=False)
+            if not cfg.uniform_drop:
+                if not cfg.xgboost_dart_mode:
+                    self.sum_weight -= self.tree_weight[i] / (kdrop + 1.0)
+                else:
+                    self.sum_weight -= self.tree_weight[i] / (kdrop + cfg.learning_rate)
+                self.tree_weight[i] *= factor
+
+    def _tree_contribution_scaled(self, it: int, rel_sign: float, on_valid: bool):
+        """Add rel_sign * current-tree-values to valid scores (used after the
+        tree's stored values were already rescaled)."""
+        import jax.numpy as jnp
+        k = self.num_tree_per_iteration
+        for cls in range(k):
+            tree = self.models[it * k + cls]
+            if tree.num_leaves <= 1:
+                continue
+            t = copy.deepcopy(tree)
+            t.leaf_value = t.leaf_value * rel_sign
+            dt = t.to_device()
+            for vi in range(len(self.valid_sets)):
+                self._valid_score[vi] = self._valid_score[vi].at[cls].add(
+                    predict_value_binned(dt, self._valid_binned[vi]))
+
+    def train_one_iter(self, gradients=None, hessians=None) -> bool:
+        self._dropping_trees()
+        stop = super().train_one_iter(gradients, hessians)
+        if not stop:
+            self.tree_weight.append(self.shrinkage_rate)
+            self.sum_weight += self.shrinkage_rate
+            self._normalize()
+        else:
+            # restore dropped trees to the train score
+            for i in self.drop_index:
+                self._tree_contribution(i, 1.0, on_valid=False)
+        return stop

@@ -1,0 +1,554 @@
+"""GBDT: the gradient-boosting training loop.
+
+TPU-native re-implementation of the reference GBDT engine
+(`src/boosting/gbdt.{h,cpp}` — TrainOneIter at gbdt.cpp:380-474): owns the
+tree learner, per-class scores, gradients, bagging, early stopping, model
+(de)serialization and prediction. The training set lives on device as a
+padded binned matrix; one `TrainOneIter` runs gradients (objective kernel),
+bagging weight sampling, and `num_class` jitted tree growths, then updates
+train/valid scores with vectorized leaf lookups.
+"""
+from __future__ import annotations
+
+import copy
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import log
+from ..config import Config
+from ..dataset import Dataset, Metadata
+from ..learner.grow import GrowerConfig, grow_tree
+from ..metrics import Metric, create_metric, default_metric_for_objective
+from ..objectives import ObjectiveFunction
+from ..ops.predict import predict_leaf_binned, predict_value_binned
+from ..tree import Tree
+
+_K_EPSILON = 1e-15
+
+
+def _jit_forest_raw(stacked, data):
+    """One jitted scan over the stacked ensemble instead of a dispatch per
+    tree (compiled once per (num_trees, max_nodes, num_rows) shape)."""
+    import jax
+    from ..ops.predict import predict_forest_raw
+    return jax.jit(predict_forest_raw)(stacked, data)
+
+
+def _jit_forest_binned(stacked, binned):
+    import jax
+    from ..ops.predict import predict_forest_binned
+    return jax.jit(predict_forest_binned)(stacked, binned)
+
+
+def _pad_to(arr: np.ndarray, n: int, value=0):
+    pad = n - arr.shape[0]
+    if pad <= 0:
+        return arr
+    width = [(0, pad)] + [(0, 0)] * (arr.ndim - 1)
+    return np.pad(arr, width, constant_values=value)
+
+
+class GBDT:
+    """Reference: class GBDT, gbdt.h:25-441."""
+
+    def __init__(self, config: Config):
+        self.config = config
+        self.iter_ = 0
+        self.models: List[Tree] = []          # flat: iter-major, class-minor
+        self.num_class = max(config.objective_config.num_class, 1)
+        self.num_tree_per_iteration = 1
+        self.objective: Optional[ObjectiveFunction] = None
+        self.train_data: Optional[Dataset] = None
+        self.metrics: List[Metric] = []
+        self.valid_sets: List[Dataset] = []
+        self.valid_names: List[str] = []
+        self.valid_metrics: List[List[Metric]] = []
+        self.best_iter: Dict[str, int] = {}
+        self.best_score: Dict[str, Dict[str, float]] = {}
+        self.init_score_bias = 0.0
+        self.average_output = False  # RF mode
+        self.shrinkage_rate = config.boosting.learning_rate
+        self._early_stop_counter: Dict = {}
+        self.max_feature_idx = 0
+        self.feature_names: List[str] = []
+        self._eval_history: List[dict] = []
+
+    # ------------------------------------------------------------------
+    def init(self, train_data: Dataset, objective: Optional[ObjectiveFunction],
+             metric_names: Sequence[str] = ()) -> None:
+        """Reference: GBDT::Init, gbdt.cpp:65-193."""
+        import jax
+        import jax.numpy as jnp
+
+        self.train_data = train_data
+        self.objective = objective
+        if objective is not None:
+            self.num_tree_per_iteration = objective.num_model_per_iteration()
+        else:
+            self.num_tree_per_iteration = self.num_class
+        self.max_feature_idx = train_data.num_total_features - 1
+        self.feature_names = list(train_data.feature_names)
+
+        n = train_data.num_data
+        f = train_data.num_features
+        # distributed learner selection (reference: CreateTreeLearner's
+        # {serial,feature,data,voting} axis, tree_learner.cpp:9-33)
+        tl = self.config.tree_learner
+        self._tree_learner_kind = tl if tl in ("data", "feature", "voting") \
+            else "serial"
+        ndev = len(jax.devices()) if self._tree_learner_kind != "serial" else 1
+        self._num_shards = ndev
+
+        chunk = min(self.config.tree.tpu_hist_chunk, 1 << 20)
+        # pick a chunk that bounds the one-hot working set; pad rows up
+        self._chunk = int(min(chunk, max(256, 1 << int(np.ceil(np.log2(max(n, 1)))))))
+        row_multiple = self._chunk * (ndev if self._tree_learner_kind in
+                                      ("data", "voting") else 1)
+        n_pad = ((n + row_multiple - 1) // row_multiple) * row_multiple
+        self._n = n
+        self._n_pad = n_pad
+
+        binned_host = _pad_to(train_data.binned, n_pad)
+        fm = train_data.feature_meta_arrays()
+        self._max_bins = int(train_data.max_num_bin())
+
+        # the objective captures its statistics (bias, class counts, query
+        # DCGs) from the REAL data, then pads its row arrays so the gradient
+        # kernels line up with the padded scores (padded rows are masked by
+        # row_weight 0 in the grower)
+        if objective is not None:
+            if train_data.metadata.label is None:
+                log.fatal("Training data must have a label")
+            objective.init(train_data.metadata, n)
+            objective.pad_to(n_pad)
+
+        self._base_weight = jnp.asarray(
+            _pad_to(np.ones(n, np.float32), n_pad))
+
+        # scores: [num_tree_per_iteration, n_pad]
+        k = self.num_tree_per_iteration
+        self._score = jnp.zeros((k, n_pad), jnp.float32)
+        init_score = train_data.metadata.init_score
+        if init_score is not None:
+            isc = np.asarray(init_score, np.float32)
+            if isc.size == n * k:
+                self._score = jnp.asarray(
+                    _pad_to(isc.reshape(k, n).T, n_pad).T.reshape(k, n_pad))
+            else:
+                self._score = self._score + jnp.asarray(_pad_to(isc, n_pad))[None, :]
+
+        # metrics
+        self.metrics = []
+        for mname in metric_names:
+            m = create_metric(mname, self.config)
+            if m is not None:
+                m.init(train_data.metadata, n)
+                self.metrics.append(m)
+
+        self._grower_cfg = GrowerConfig(
+            num_leaves=self.config.tree.num_leaves,
+            max_bins=self._max_bins,
+            chunk=self._chunk,
+            lambda_l1=self.config.tree.lambda_l1,
+            lambda_l2=self.config.tree.lambda_l2,
+            min_gain_to_split=self.config.tree.min_gain_to_split,
+            min_data_in_leaf=self.config.tree.min_data_in_leaf,
+            min_sum_hessian_in_leaf=self.config.tree.min_sum_hessian_in_leaf,
+            max_depth=self.config.tree.max_depth,
+        )
+
+        # build the distributed grower + finalize the (possibly feature-
+        # padded) device-resident binned matrix
+        self._dist_grower = None
+        if self._tree_learner_kind != "serial" and ndev >= 1:
+            from ..parallel import (DataParallelGrower, FeatureParallelGrower,
+                                    VotingParallelGrower, make_mesh)
+            if self._tree_learner_kind == "feature":
+                mesh = make_mesh(axis_name="feature")
+                self._dist_grower = FeatureParallelGrower(
+                    mesh, self._grower_cfg, axis="feature")
+                binned_host, fm = self._dist_grower.pad_features(binned_host, fm)
+            else:
+                mesh = make_mesh(axis_name="data")
+                cls = VotingParallelGrower if self._tree_learner_kind == "voting" \
+                    else DataParallelGrower
+                self._dist_grower = cls(mesh, self._grower_cfg, axis="data")
+            log.info("Using %s-parallel tree learner over %d devices",
+                     self._tree_learner_kind, ndev)
+        self._binned = jnp.asarray(binned_host)
+        self._num_features_padded = binned_host.shape[1]
+        self._fmeta = {k: jnp.asarray(v) for k, v in fm.items()}
+
+        self._feature_rng = np.random.RandomState(self.config.tree.feature_fraction_seed)
+        self._bagging_rng = np.random.RandomState(self.config.boosting.bagging_seed)
+
+        # boost from average (gbdt.cpp:358-378)
+        if (objective is not None and objective.boost_from_average()
+                and self.config.objective_config.boost_from_average
+                and self.num_tree_per_iteration == 1):
+            self.init_score_bias = objective.bias()
+            if self.init_score_bias != 0.0:
+                self._score = self._score + self.init_score_bias
+                log.info("Start training from score %f", self.init_score_bias)
+
+    def add_valid(self, valid_data: Dataset, name: str,
+                  metric_names: Sequence[str] = ()) -> None:
+        """Reference: GBDT::AddValidDataset, gbdt.cpp:204-224."""
+        import jax.numpy as jnp
+        self.valid_sets.append(valid_data)
+        self.valid_names.append(name)
+        ms = []
+        for mname in metric_names:
+            m = create_metric(mname, self.config)
+            if m is not None:
+                m.init(valid_data.metadata, valid_data.num_data)
+                ms.append(m)
+        self.valid_metrics.append(ms)
+        if not hasattr(self, "_valid_binned"):
+            self._valid_binned = []
+            self._valid_score = []
+        vb = jnp.asarray(valid_data.binned)
+        self._valid_binned.append(vb)
+        k = self.num_tree_per_iteration
+        vs = jnp.zeros((k, valid_data.num_data), jnp.float32)
+        init_score = valid_data.metadata.init_score
+        if init_score is not None:
+            isc = np.asarray(init_score, np.float32)
+            nv = valid_data.num_data
+            if isc.size == nv * k:
+                vs = jnp.asarray(isc.reshape(k, nv))
+            else:
+                vs = vs + jnp.asarray(isc)[None, :]
+        if self.init_score_bias != 0.0:
+            vs = vs + self.init_score_bias
+        # replay existing trees (continued training on new valid set)
+        for it in range(self.iter_):
+            for cls in range(k):
+                tree = self.models[it * k + cls]
+                vs = vs.at[cls].add(predict_value_binned(tree.to_device(), vb))
+        self._valid_score.append(vs)
+
+    # ------------------------------------------------------------------
+    def _bagging_weights(self, iter_idx: int, grad=None, hess=None) -> np.ndarray:
+        """0/1 in-bag weights (reference: GBDT::Bagging, gbdt.cpp:225-286).
+        GOSS overrides this using the gradient magnitudes (goss.hpp:87-131)."""
+        bf = self.config.boosting.bagging_fraction
+        freq = self.config.boosting.bagging_freq
+        n = self._n
+        if bf >= 1.0 or freq <= 0:
+            return None
+        if iter_idx % freq == 0 or not hasattr(self, "_bag_cache"):
+            take = int(n * bf)
+            idx = self._bagging_rng.choice(n, size=take, replace=False)
+            w = np.zeros(n, np.float32)
+            w[idx] = 1.0
+            self._bag_cache = w
+        return self._bag_cache
+
+    def _feature_mask(self) -> np.ndarray:
+        """Per-tree feature_fraction sample (serial_tree_learner.cpp:239-257)."""
+        f = self.train_data.num_features
+        frac = self.config.tree.feature_fraction
+        if frac >= 1.0:
+            mask = np.ones(f, bool)
+        else:
+            used = max(1, int(f * frac))
+            idx = self._feature_rng.choice(f, size=used, replace=False)
+            mask = np.zeros(f, bool)
+            mask[idx] = True
+        if self._num_features_padded > f:
+            mask = np.pad(mask, (0, self._num_features_padded - f))
+        return mask
+
+    def _grow(self, grad, hess, row_weight, feature_mask):
+        """Dispatch one tree growth to the serial or distributed grower."""
+        import jax.numpy as jnp
+        if self._dist_grower is not None:
+            return self._dist_grower(self._binned, grad, hess, row_weight,
+                                     jnp.asarray(feature_mask), self._fmeta)
+        return grow_tree(
+            self._binned, grad, hess, row_weight, jnp.asarray(feature_mask),
+            self._fmeta["num_bin"], self._fmeta["missing_type"],
+            self._fmeta["default_bin"], self._fmeta["is_categorical"],
+            self._grower_cfg)
+
+    # ------------------------------------------------------------------
+    def _compute_gradients(self, score) -> Tuple:
+        return self.objective.get_gradients(score.reshape(-1))
+
+    def train_one_iter(self, gradients: Optional[np.ndarray] = None,
+                       hessians: Optional[np.ndarray] = None) -> bool:
+        """One boosting iteration (reference: GBDT::TrainOneIter,
+        gbdt.cpp:380-474). Returns True when no further splits are possible
+        (training should stop)."""
+        import jax.numpy as jnp
+
+        k = self.num_tree_per_iteration
+        n_pad = self._n_pad
+        if gradients is None or hessians is None:
+            if self.objective is None:
+                log.fatal("Custom objective training requires explicit "
+                          "gradients and hessians")
+            grad, hess = self._compute_gradients(self._score)
+        else:
+            grad = jnp.asarray(np.asarray(gradients, np.float32).reshape(k, -1))
+            hess = jnp.asarray(np.asarray(hessians, np.float32).reshape(k, -1))
+            if grad.shape[1] != n_pad:
+                grad = jnp.asarray(_pad_to(np.asarray(grad).T, n_pad).T)
+                hess = jnp.asarray(_pad_to(np.asarray(hess).T, n_pad).T)
+            grad = grad.reshape(-1)
+            hess = hess.reshape(-1)
+        grad = grad.reshape(k, n_pad)
+        hess = hess.reshape(k, n_pad)
+
+        bag = self._bagging_weights(self.iter_, grad, hess)
+        row_weight = self._base_weight if bag is None else \
+            jnp.asarray(_pad_to(bag, n_pad))
+
+        could_split_any = False
+        for cls in range(k):
+            mask = self._feature_mask()
+            state = self._grow(grad[cls], hess[cls], row_weight, mask)
+            tree = Tree.from_grower_state(state, self.train_data)
+            if tree.num_leaves > 1:
+                could_split_any = True
+                tree.apply_shrinkage(self.shrinkage_rate)
+                # train score update via leaf ids (UpdateScore, gbdt.cpp:521)
+                leaf_vals = jnp.asarray(tree.leaf_value, jnp.float32)
+                self._score = self._score.at[cls].add(
+                    leaf_vals[jnp.clip(state.leaf_id, 0, tree.num_leaves - 1)])
+                dtree = tree.to_device()
+                for vi in range(len(self.valid_sets)):
+                    self._valid_score[vi] = self._valid_score[vi].at[cls].add(
+                        predict_value_binned(dtree, self._valid_binned[vi]))
+            self.models.append(tree)
+
+        self.iter_ += 1
+        if not could_split_any:
+            # reference: "Stopped training because there are no more leaves
+            # that meet the split requirements" (gbdt.cpp:466-472)
+            for _ in range(k):
+                self.models.pop()
+            self.iter_ -= 1
+            log.warning("Stopped training because there are no more leaves "
+                        "that meet the split requirements")
+            return True
+        return False
+
+    def rollback_one_iter(self) -> None:
+        """Reference: GBDT::RollbackOneIter, gbdt.cpp:476-492."""
+        import jax.numpy as jnp
+        if self.iter_ <= 0:
+            return
+        k = self.num_tree_per_iteration
+        for cls in reversed(range(k)):
+            tree = self.models.pop()
+            if tree.num_leaves > 1:
+                neg = copy.deepcopy(tree)
+                neg.leaf_value = -neg.leaf_value
+                dtree = neg.to_device()
+                self._score = self._score.at[cls].add(
+                    predict_value_binned(dtree, self._binned))
+                for vi in range(len(self.valid_sets)):
+                    self._valid_score[vi] = self._valid_score[vi].at[cls].add(
+                        predict_value_binned(dtree, self._valid_binned[vi]))
+        self.iter_ -= 1
+
+    # ------------------------------------------------------------------
+    def eval_once(self) -> List[Tuple[str, str, float, bool]]:
+        """Evaluate all metrics; returns (data_name, metric_name, value,
+        is_bigger_better) tuples (reference: GBDT::OutputMetric,
+        gbdt.cpp:575-632)."""
+        out = []
+        if self.metrics and self.config.metric.is_provide_training_metric:
+            train_score = self._train_score_unpadded()
+            for m in self.metrics:
+                for name, val in m.eval(train_score, self.objective):
+                    out.append(("training", name, val, m.is_bigger_better))
+        for vi, ms in enumerate(self.valid_metrics):
+            vscore = np.asarray(self._valid_score[vi], np.float64).reshape(-1)
+            for m in ms:
+                for name, val in m.eval(vscore, self.objective):
+                    out.append((self.valid_names[vi], name, val, m.is_bigger_better))
+        return out
+
+    def _train_score_unpadded(self) -> np.ndarray:
+        s = np.asarray(self._score, np.float64)
+        return s[:, :self._n].reshape(-1)
+
+    # ------------------------------------------------------------------
+    def num_trees(self) -> int:
+        return len(self.models)
+
+    def current_iteration(self) -> int:
+        return self.iter_
+
+    # ------------------------------------------------------------------
+    # prediction (reference: gbdt_prediction.cpp + Predictor)
+    def _predict_raw_matrix(self, data: np.ndarray,
+                            num_iteration: int = -1) -> np.ndarray:
+        """Raw scores [num_data, num_tree_per_iteration] from raw features."""
+        import jax.numpy as jnp
+        from ..ops.predict import predict_value_raw
+        data = np.asarray(data, np.float32)
+        n = data.shape[0]
+        k = self.num_tree_per_iteration
+        total = len(self.models)
+        if num_iteration > 0:
+            total = min(total, num_iteration * k)
+        out = np.zeros((k, n), np.float64)
+        dj = jnp.asarray(data)
+        if total > 0:
+            from ..ops.predict import predict_forest_raw, stack_trees_raw
+            for cls in range(k):
+                class_trees = [self.models[i] for i in range(cls, total, k)]
+                if not class_trees:
+                    continue
+                stacked = stack_trees_raw(class_trees)
+                out[cls] = np.asarray(
+                    _jit_forest_raw(stacked, dj), np.float64)
+        if self.average_output and total > 0:
+            out /= max(total // k, 1)
+        out += self.init_score_bias
+        return out.T
+
+    def predict(self, data: np.ndarray, num_iteration: int = -1,
+                raw_score: bool = False, pred_leaf: bool = False,
+                pred_contrib: bool = False) -> np.ndarray:
+        import jax.numpy as jnp
+        if pred_leaf:
+            from ..ops.predict import predict_leaf_raw
+            data = np.asarray(data, np.float32)
+            k = self.num_tree_per_iteration
+            total = len(self.models)
+            if num_iteration > 0:
+                total = min(total, num_iteration * k)
+            dj = jnp.asarray(data)
+            leaves = [np.asarray(predict_leaf_raw(self.models[i].to_device_raw(), dj))
+                      for i in range(total)]
+            return np.stack(leaves, axis=1) if leaves else \
+                np.zeros((data.shape[0], 0), np.int32)
+        if pred_contrib:
+            from ..shap import predict_contrib
+            return predict_contrib(self, np.asarray(data, np.float64), num_iteration)
+        raw = self._predict_raw_matrix(data, num_iteration)
+        if raw_score or self.objective is None:
+            return raw[:, 0] if raw.shape[1] == 1 else raw
+        conv = np.asarray(self.objective.convert_output(
+            jnp.asarray(raw.T.reshape(-1), jnp.float32)), np.float64)
+        k = self.num_tree_per_iteration
+        if k == 1:
+            return conv
+        return conv.reshape(k, -1).T
+
+    # ------------------------------------------------------------------
+    # model text IO (reference: gbdt_model.cpp:170-370)
+    def model_name(self) -> str:
+        return "tree"
+
+    def save_model_to_string(self, num_iteration: int = -1) -> str:
+        out = [self.model_name()]
+        out.append("version=v2_tpu")
+        out.append(f"num_class={self.num_class}")
+        out.append(f"num_tree_per_iteration={self.num_tree_per_iteration}")
+        out.append("label_index=0")
+        out.append(f"max_feature_idx={self.max_feature_idx}")
+        if self.objective is not None:
+            out.append(f"objective={self.objective.to_string()}")
+        if self.average_output:
+            out.append("average_output")
+        out.append("feature_names=" + " ".join(self.feature_names))
+        out.append(f"init_score_bias={self.init_score_bias}")
+        out.append("")
+        total = len(self.models)
+        if num_iteration > 0:
+            total = min(total, num_iteration * self.num_tree_per_iteration)
+        for i in range(total):
+            out.append(f"Tree={i}")
+            out.append(self.models[i].to_string())
+        out.append("end of trees")
+        out.append("")
+        imp = self.feature_importance("split")
+        pairs = sorted(((v, self.feature_names[i]) for i, v in enumerate(imp) if v > 0),
+                       reverse=True)
+        out.append("feature importances:")
+        for v, name in pairs:
+            out.append(f"{name}={int(v)}")
+        return "\n".join(out) + "\n"
+
+    def save_model(self, filename: str, num_iteration: int = -1) -> None:
+        with open(filename, "w") as fh:
+            fh.write(self.save_model_to_string(num_iteration))
+        log.info("Saved model to %s", filename)
+
+    def load_model_from_string(self, text: str) -> None:
+        """Reference: GBDT::LoadModelFromString, gbdt_model.cpp:247-330."""
+        lines = text.splitlines()
+        kv = {}
+        tree_blocks: List[List[str]] = []
+        cur: Optional[List[str]] = None
+        for line in lines:
+            ls = line.strip()
+            if ls.startswith("Tree="):
+                if cur is not None:
+                    tree_blocks.append(cur)
+                cur = []
+                continue
+            if ls == "end of trees":
+                if cur is not None:
+                    tree_blocks.append(cur)
+                cur = None
+                continue
+            if cur is not None:
+                if ls:
+                    cur.append(ls)
+            elif "=" in ls:
+                k, v = ls.split("=", 1)
+                kv[k] = v
+            elif ls == "average_output":
+                kv["average_output"] = "1"
+        if cur:
+            tree_blocks.append(cur)
+        self.num_class = int(kv.get("num_class", 1))
+        self.num_tree_per_iteration = int(kv.get("num_tree_per_iteration", self.num_class))
+        self.max_feature_idx = int(kv.get("max_feature_idx", 0))
+        self.feature_names = kv.get("feature_names", "").split()
+        self.init_score_bias = float(kv.get("init_score_bias", 0.0))
+        self.average_output = "average_output" in kv
+        self.models = [Tree.from_string("\n".join(b)) for b in tree_blocks]
+        self.iter_ = len(self.models) // max(self.num_tree_per_iteration, 1)
+
+    # ------------------------------------------------------------------
+    def feature_importance(self, importance_type: str = "split",
+                           num_iteration: int = -1) -> np.ndarray:
+        """Reference: GBDT::FeatureImportance (gbdt_model.cpp:335-370)."""
+        nf = self.max_feature_idx + 1
+        imp = np.zeros(nf, np.float64)
+        total = len(self.models)
+        if num_iteration > 0:
+            total = min(total, num_iteration * self.num_tree_per_iteration)
+        for i in range(total):
+            t = self.models[i]
+            m = t.num_leaves - 1
+            for j in range(m):
+                if importance_type == "split":
+                    imp[t.split_feature[j]] += 1
+                else:
+                    imp[t.split_feature[j]] += max(t.split_gain[j], 0.0)
+        return imp
+
+    def dump_model(self, num_iteration: int = -1) -> dict:
+        total = len(self.models)
+        if num_iteration > 0:
+            total = min(total, num_iteration * self.num_tree_per_iteration)
+        return {
+            "name": "tree",
+            "version": "v2_tpu",
+            "num_class": self.num_class,
+            "num_tree_per_iteration": self.num_tree_per_iteration,
+            "label_index": 0,
+            "max_feature_idx": self.max_feature_idx,
+            "feature_names": self.feature_names,
+            "tree_info": [t.to_json() for t in self.models[:total]],
+        }

@@ -1,0 +1,52 @@
+"""GOSS: Gradient-based One-Side Sampling.
+
+Reference: `src/boosting/goss.hpp` — keep the top_rate fraction of rows by
+|grad*hess|, sample other_rate of the rest, and amplify the sampled rows'
+gradients and hessians by (1-top_rate)/other_rate (BaggingHelper,
+goss.hpp:87-131). Sampling starts after 1/learning_rate iterations
+(goss.hpp:135-138). In the leaf-id design the amplification folds into the
+per-row weight channel fed to the histogram kernel.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import log
+from .gbdt import GBDT
+
+
+class GOSS(GBDT):
+    def __init__(self, config):
+        super().__init__(config)
+        if config.boosting.top_rate <= 0 or config.boosting.other_rate <= 0:
+            log.fatal("GOSS requires top_rate > 0 and other_rate > 0")
+        if config.boosting.bagging_freq > 0 and config.boosting.bagging_fraction != 1.0:
+            log.fatal("Cannot use bagging in GOSS")
+        log.info("Using GOSS")
+        self._goss_rng = np.random.RandomState(config.boosting.bagging_seed)
+
+    def model_name(self) -> str:
+        return "goss"
+
+    def _bagging_weights(self, iter_idx, grad=None, hess=None):
+        cfg = self.config.boosting
+        n = self._n
+        # no subsampling for the first 1/lr iterations (goss.hpp:137)
+        if iter_idx < int(1.0 / max(cfg.learning_rate, 1e-12)) or grad is None:
+            return None
+        g = np.asarray(grad, np.float64).reshape(self.num_tree_per_iteration, -1)[:, :n]
+        h = np.asarray(hess, np.float64).reshape(self.num_tree_per_iteration, -1)[:, :n]
+        mag = np.abs(g * h).sum(axis=0)
+        top_k = max(1, int(n * cfg.top_rate))
+        other_k = max(1, int(n * cfg.other_rate))
+        order = np.argsort(-mag, kind="stable")
+        top_idx = order[:top_k]
+        rest_idx = order[top_k:]
+        multiply = (n - top_k) / other_k
+        w = np.zeros(n, np.float32)
+        w[top_idx] = 1.0
+        if len(rest_idx) > 0:
+            sampled = self._goss_rng.choice(
+                rest_idx, size=min(other_k, len(rest_idx)), replace=False)
+            w[sampled] = multiply
+        return w

@@ -1,0 +1,159 @@
+"""Command-line application: train / predict / convert_model.
+
+Re-implements the reference CLI (`src/main.cpp:4-22`,
+`src/application/application.cpp:30-258`): `python -m lightgbm_tpu
+config=train.conf [key=value ...]` with the same config-file format
+(key=value lines, '#' comments), task dispatch, data/validation loading
+(label/weight/query sidecar files), model output and prediction-result
+files — so the reference's `examples/*/train.conf` run unmodified.
+"""
+from __future__ import annotations
+
+import os
+import sys
+from typing import Dict, List
+
+import numpy as np
+
+from . import log
+from .basic import Booster, Dataset
+from .config import Config, key_alias_transform
+from .engine import train
+from .io.parser import (load_data_file, load_query_file, load_weight_file)
+from .metrics import default_metric_for_objective
+
+
+def load_config_file(path: str) -> Dict[str, str]:
+    """Reference: Application::LoadParameters config-file branch
+    (application.cpp:48-104)."""
+    out: Dict[str, str] = {}
+    with open(path) as fh:
+        for line in fh:
+            line = line.split("#", 1)[0].strip()
+            if not line or "=" not in line:
+                continue
+            k, v = line.split("=", 1)
+            out[k.strip()] = v.strip().strip('"')
+    return out
+
+
+def parse_cli_params(argv: List[str]) -> Dict[str, str]:
+    params: Dict[str, str] = {}
+    for tok in argv:
+        if "=" not in tok:
+            log.warning("Unknown CLI token (ignored): %s", tok)
+            continue
+        k, v = tok.split("=", 1)
+        params[k.strip()] = v.strip()
+    # config file params have LOWER priority than CLI (application.cpp:75-90);
+    # both sides are alias-canonicalized before merging so "num_trees=3" on
+    # the CLI overrides "num_iterations=50" in the file
+    params = key_alias_transform(params)
+    cfg_path = params.get("config_file")
+    if cfg_path:
+        file_params = key_alias_transform(load_config_file(cfg_path))
+        for k, v in file_params.items():
+            params.setdefault(k, v)
+    return params
+
+
+def _build_dataset(path: str, params: Dict, cfg: Config,
+                   reference: Dataset = None) -> Dataset:
+    has_header = cfg.io.has_header
+    data, label = load_data_file(path, has_header=has_header)
+    ds = Dataset(data, label=label, params=dict(params), reference=reference)
+    weights = load_weight_file(path)
+    if weights is not None:
+        ds.set_weight(weights)
+    query = load_query_file(path)
+    if query is not None:
+        ds.set_group(query)
+    init_path = path + ".init"
+    if os.path.exists(init_path):
+        with open(init_path) as fh:
+            ds.set_init_score(np.asarray([float(x) for x in fh.read().split()]))
+    return ds
+
+
+def run_train(params: Dict, cfg: Config) -> None:
+    """Reference: Application::InitTrain + Train (application.cpp:190-234)."""
+    if not cfg.data:
+        log.fatal("No training data specified (data=...)")
+    log.info("Loading train data from %s", cfg.data)
+    train_set = _build_dataset(cfg.data, params, cfg)
+    valid_sets, valid_names = [], []
+    for vpath in cfg.valid_data:
+        log.info("Loading validation data from %s", vpath)
+        valid_sets.append(_build_dataset(vpath, params, cfg, reference=train_set))
+        valid_names.append(os.path.basename(vpath))
+
+    booster = train(params, train_set,
+                    num_boost_round=cfg.boosting.num_iterations,
+                    valid_sets=valid_sets, valid_names=valid_names,
+                    verbose_eval=cfg.metric.metric_freq
+                    if cfg.io.verbosity >= 1 else False,
+                    early_stopping_rounds=cfg.boosting.early_stopping_round
+                    or None)
+    booster.save_model(cfg.io.output_model)
+    log.info("Finished training, model saved to %s", cfg.io.output_model)
+
+
+def run_predict(params: Dict, cfg: Config) -> None:
+    """Reference: Application::Predict (application.cpp:236-249) +
+    Predictor (predictor.hpp:24-205)."""
+    if not cfg.io.input_model:
+        log.fatal("No input model specified (input_model=...)")
+    if not cfg.data:
+        log.fatal("No prediction data specified (data=...)")
+    booster = Booster(model_file=cfg.io.input_model, params=dict(params))
+    data, _ = load_data_file(cfg.data, has_header=cfg.io.has_header)
+    result = booster.predict(
+        data,
+        num_iteration=cfg.io.num_iteration_predict,
+        raw_score=cfg.io.is_predict_raw_score,
+        pred_leaf=cfg.io.is_predict_leaf_index,
+        pred_contrib=cfg.io.is_predict_contrib)
+    result = np.atleast_1d(np.asarray(result))
+    with open(cfg.io.output_result, "w") as fh:
+        for row in result:
+            if np.ndim(row) == 0:
+                fh.write(f"{float(row):.9g}\n")
+            else:
+                fh.write("\t".join(f"{float(x):.9g}" for x in row) + "\n")
+    log.info("Finished prediction, results saved to %s", cfg.io.output_result)
+
+
+def run_convert_model(params: Dict, cfg: Config) -> None:
+    """Reference: kConvertModel task (application.cpp:251-258 +
+    gbdt_model.cpp ModelToIfElse) — emits standalone C++ if-else code."""
+    from .io.convert_model import model_to_if_else
+    booster = Booster(model_file=cfg.io.input_model, params=dict(params))
+    code = model_to_if_else(booster._inner)
+    with open(cfg.io.convert_model, "w") as fh:
+        fh.write(code)
+    log.info("Model converted to C++ code at %s", cfg.io.convert_model)
+
+
+def main(argv: List[str] = None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    params = parse_cli_params(argv)
+    cfg = Config.from_params(params)
+    if cfg.io.verbosity < 0:
+        log.set_level(log.WARNING)
+    elif cfg.io.verbosity >= 2:
+        log.set_level(log.DEBUG)
+
+    task = cfg.task
+    if task == "train":
+        run_train(params, cfg)
+    elif task in ("predict", "prediction", "test"):
+        run_predict(params, cfg)
+    elif task == "convert_model":
+        run_convert_model(params, cfg)
+    else:
+        log.fatal("Unknown task: %s" % task)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

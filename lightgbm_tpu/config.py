@@ -1,0 +1,400 @@
+"""Unified parameter pipeline.
+
+TPU-native re-implementation of the reference config system
+(`include/LightGBM/config.h:273-483`, `src/io/config.cpp`): a single
+string-map pipeline shared by the CLI, config files, and Python kwargs —
+alias transform -> closed whitelist (fatal on unknown key) -> typed nested
+config structs -> conflict checks deriving `is_parallel` etc.
+"""
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from . import log
+
+# ---------------------------------------------------------------------------
+# Alias table (reference: ParameterAlias::KeyAliasTransform, config.h:351-483)
+# ---------------------------------------------------------------------------
+ALIAS_TABLE: Dict[str, str] = {
+    "config": "config_file",
+    "nthread": "num_threads",
+    "num_thread": "num_threads",
+    "random_seed": "seed",
+    "boosting": "boosting_type",
+    "boost": "boosting_type",
+    "application": "objective",
+    "app": "objective",
+    "loss": "objective",
+    "train_data": "data",
+    "train": "data",
+    "model_output": "output_model",
+    "model_out": "output_model",
+    "model_input": "input_model",
+    "model_in": "input_model",
+    "predict_result": "output_result",
+    "prediction_result": "output_result",
+    "valid": "valid_data",
+    "test_data": "valid_data",
+    "test": "valid_data",
+    "is_sparse": "is_enable_sparse",
+    "enable_sparse": "is_enable_sparse",
+    "pre_partition": "is_pre_partition",
+    "training_metric": "is_training_metric",
+    "train_metric": "is_training_metric",
+    "ndcg_at": "ndcg_eval_at",
+    "eval_at": "ndcg_eval_at",
+    "min_data_per_leaf": "min_data_in_leaf",
+    "min_data": "min_data_in_leaf",
+    "min_child_samples": "min_data_in_leaf",
+    "min_sum_hessian_per_leaf": "min_sum_hessian_in_leaf",
+    "min_sum_hessian": "min_sum_hessian_in_leaf",
+    "min_hessian": "min_sum_hessian_in_leaf",
+    "min_child_weight": "min_sum_hessian_in_leaf",
+    "num_leaf": "num_leaves",
+    "sub_feature": "feature_fraction",
+    "colsample_bytree": "feature_fraction",
+    "num_iteration": "num_iterations",
+    "num_tree": "num_iterations",
+    "num_round": "num_iterations",
+    "num_trees": "num_iterations",
+    "num_rounds": "num_iterations",
+    "num_boost_round": "num_iterations",
+    "sub_row": "bagging_fraction",
+    "subsample": "bagging_fraction",
+    "subsample_freq": "bagging_freq",
+    "shrinkage_rate": "learning_rate",
+    "tree": "tree_learner",
+    "num_machine": "num_machines",
+    "local_port": "local_listen_port",
+    "two_round_loading": "use_two_round_loading",
+    "two_round": "use_two_round_loading",
+    "mlist": "machine_list_file",
+    "is_save_binary": "is_save_binary_file",
+    "save_binary": "is_save_binary_file",
+    "early_stopping_rounds": "early_stopping_round",
+    "early_stopping": "early_stopping_round",
+    "verbosity": "verbose",
+    "header": "has_header",
+    "label": "label_column",
+    "weight": "weight_column",
+    "group": "group_column",
+    "query": "group_column",
+    "query_column": "group_column",
+    "ignore_feature": "ignore_column",
+    "blacklist": "ignore_column",
+    "categorical_feature": "categorical_column",
+    "cat_column": "categorical_column",
+    "cat_feature": "categorical_column",
+    "predict_raw_score": "is_predict_raw_score",
+    "predict_leaf_index": "is_predict_leaf_index",
+    "raw_score": "is_predict_raw_score",
+    "leaf_index": "is_predict_leaf_index",
+    "contrib": "is_predict_contrib",
+    "predict_contrib": "is_predict_contrib",
+    "min_split_gain": "min_gain_to_split",
+    "topk": "top_k",
+    "reg_alpha": "lambda_l1",
+    "reg_lambda": "lambda_l2",
+    "num_classes": "num_class",
+    "unbalanced_sets": "is_unbalance",
+    "bagging_fraction_seed": "bagging_seed",
+}
+
+
+@dataclass
+class IOConfig:
+    """Reference: IOConfig, config.h:101-160."""
+    max_bin: int = 255
+    min_data_in_bin: int = 3
+    bin_construct_sample_cnt: int = 200000
+    data_random_seed: int = 1
+    output_model: str = "LightGBM_model.txt"
+    output_result: str = "LightGBM_predict_result.txt"
+    convert_model: str = "gbdt_prediction.cpp"
+    input_model: str = ""
+    verbosity: int = 1
+    num_iteration_predict: int = -1
+    is_pre_partition: bool = False
+    is_enable_sparse: bool = True
+    enable_load_from_binary_file: bool = True
+    use_two_round_loading: bool = False
+    is_save_binary_file: bool = False
+    enable_bundle: bool = True
+    max_conflict_rate: float = 0.0
+    has_header: bool = False
+    label_column: str = ""
+    weight_column: str = ""
+    group_column: str = ""
+    ignore_column: str = ""
+    categorical_column: str = ""
+    data_filename: str = ""
+    valid_data_filenames: List[str] = field(default_factory=list)
+    snapshot_freq: int = -1
+    is_predict_raw_score: bool = False
+    is_predict_leaf_index: bool = False
+    is_predict_contrib: bool = False
+    pred_early_stop: bool = False
+    pred_early_stop_freq: int = 10
+    pred_early_stop_margin: float = 10.0
+    use_missing: bool = True
+    zero_as_missing: bool = False
+    sparse_threshold: float = 0.8
+    init_score_file: str = ""
+    valid_init_score_file: List[str] = field(default_factory=list)
+
+
+@dataclass
+class TreeConfig:
+    """Reference: TreeConfig, config.h:162-230."""
+    min_data_in_leaf: int = 20
+    min_sum_hessian_in_leaf: float = 1e-3
+    lambda_l1: float = 0.0
+    lambda_l2: float = 0.0
+    min_gain_to_split: float = 0.0
+    num_leaves: int = 31
+    feature_fraction: float = 1.0
+    feature_fraction_seed: int = 2
+    max_depth: int = -1
+    top_k: int = 20
+    max_cat_threshold: int = 256
+    histogram_pool_size: float = -1.0
+    # TPU-specific knobs (no reference analogue; gpu_* kept for API compat)
+    gpu_platform_id: int = -1
+    gpu_device_id: int = -1
+    gpu_use_dp: bool = False
+    tpu_hist_chunk: int = 16384
+    tpu_double_precision: bool = False
+
+
+@dataclass
+class ObjectiveConfig:
+    """Reference: ObjectiveConfig, config.h:232-252."""
+    is_unbalance: bool = False
+    sigmoid: float = 1.0
+    huber_delta: float = 1.0
+    fair_c: float = 1.0
+    poisson_max_delta_step: float = 0.7
+    gaussian_eta: float = 1.0
+    scale_pos_weight: float = 1.0
+    boost_from_average: bool = True
+    label_gain: List[float] = field(default_factory=list)
+    max_position: int = 20
+    num_class: int = 1
+
+
+@dataclass
+class MetricConfig:
+    """Reference: MetricConfig, config.h:254-264."""
+    metric_freq: int = 1
+    is_provide_training_metric: bool = False
+    ndcg_eval_at: List[int] = field(default_factory=lambda: [1, 2, 3, 4, 5])
+    metric_types: List[str] = field(default_factory=list)
+
+
+@dataclass
+class NetworkConfig:
+    """Reference: NetworkConfig, config.h:266-276. On TPU the 'machines' are
+    mesh devices/hosts; socket options are accepted for compat but unused."""
+    num_machines: int = 1
+    local_listen_port: int = 12400
+    time_out: int = 120
+    machine_list_filename: str = ""
+
+
+@dataclass
+class BoostingConfig:
+    """Reference: BoostingConfig, config.h:278-330."""
+    output_freq: int = 1
+    num_iterations: int = 100
+    bagging_seed: int = 3
+    bagging_fraction: float = 1.0
+    bagging_freq: int = 0
+    learning_rate: float = 0.1
+    early_stopping_round: int = 0
+    # DART
+    drop_rate: float = 0.1
+    max_drop: int = 50
+    skip_drop: float = 0.5
+    xgboost_dart_mode: bool = False
+    uniform_drop: bool = False
+    drop_seed: int = 4
+    # GOSS
+    top_rate: float = 0.2
+    other_rate: float = 0.1
+
+
+_BOOL_TRUE = {"true", "1", "yes", "y", "t", "+"}
+_BOOL_FALSE = {"false", "0", "no", "n", "f", "-"}
+
+
+def _parse_value(value: Any, target_type: type):
+    if target_type is bool:
+        if isinstance(value, bool):
+            return value
+        s = str(value).strip().lower()
+        if s in _BOOL_TRUE:
+            return True
+        if s in _BOOL_FALSE:
+            return False
+        log.fatal("Cannot parse '%s' as bool" % value)
+    if target_type is int:
+        return int(float(value)) if not isinstance(value, int) else value
+    if target_type is float:
+        return float(value)
+    if target_type is str:
+        return str(value)
+    return value
+
+
+def _parse_list(value: Any, elem_type: type) -> list:
+    if isinstance(value, (list, tuple)):
+        return [_parse_value(v, elem_type) for v in value]
+    s = str(value).strip()
+    if not s:
+        return []
+    return [_parse_value(v, elem_type) for v in s.replace(",", " ").split()]
+
+
+@dataclass
+class Config:
+    """Overall config (reference: OverallConfig, config.h:332-349)."""
+    task: str = "train"
+    device: str = "tpu"
+    seed: Optional[int] = None
+    num_threads: int = 0
+    boosting_type: str = "gbdt"
+    objective: str = "regression"
+    tree_learner: str = "serial"
+    data: str = ""
+    valid_data: List[str] = field(default_factory=list)
+    io: IOConfig = field(default_factory=IOConfig)
+    tree: TreeConfig = field(default_factory=TreeConfig)
+    boosting: BoostingConfig = field(default_factory=BoostingConfig)
+    objective_config: ObjectiveConfig = field(default_factory=ObjectiveConfig)
+    metric: MetricConfig = field(default_factory=MetricConfig)
+    network: NetworkConfig = field(default_factory=NetworkConfig)
+    is_parallel: bool = False
+    is_parallel_find_bin: bool = False
+    raw_params: Dict[str, Any] = field(default_factory=dict)
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_params(cls, params: Dict[str, Any]) -> "Config":
+        params = key_alias_transform(params)
+        cfg = cls()
+        cfg.raw_params = dict(params)
+        sections = [cfg.io, cfg.tree, cfg.boosting, cfg.objective_config,
+                    cfg.metric, cfg.network]
+        list_fields = {
+            "valid_data": str, "valid_data_filenames": str,
+            "ndcg_eval_at": int, "metric_types": str, "label_gain": float,
+            "valid_init_score_file": str,
+        }
+        top_fields = {f.name: f.type for f in dataclasses.fields(cls)
+                      if f.name not in ("io", "tree", "boosting", "objective_config",
+                                        "metric", "network", "raw_params")}
+        for key, value in params.items():
+            if key in ("config_file",):
+                continue
+            if key == "metric":
+                cfg.metric.metric_types = [m for m in _parse_list(value, str)]
+                continue
+            if key == "verbose":
+                cfg.io.verbosity = _parse_value(value, int)
+                continue
+            if key == "machine_list_file":
+                cfg.network.machine_list_filename = str(value)
+                continue
+            if key == "valid_data":
+                cfg.valid_data = _parse_list(value, str)
+                cfg.io.valid_data_filenames = cfg.valid_data
+                continue
+            if key == "data":
+                cfg.data = str(value)
+                cfg.io.data_filename = str(value)
+                continue
+            if key == "poission_max_delta_step":  # reference typo kept as alias
+                cfg.objective_config.poisson_max_delta_step = _parse_value(value, float)
+                continue
+            placed = False
+            if key in top_fields and key != "seed":
+                setattr(cfg, key, _parse_value(value, type(getattr(cfg, key))))
+                placed = True
+            elif key == "seed":
+                cfg.seed = _parse_value(value, int)
+                placed = True
+            else:
+                for sec in sections:
+                    if hasattr(sec, key):
+                        cur = getattr(sec, key)
+                        if isinstance(cur, list):
+                            setattr(sec, key, _parse_list(value, list_fields.get(key, str)))
+                        else:
+                            setattr(sec, key, _parse_value(value, type(cur)))
+                        placed = True
+                        break
+            if not placed:
+                log.fatal("Unknown parameter: %s" % key)
+        cfg._apply_seed()
+        cfg.check_param_conflict()
+        return cfg
+
+    def _apply_seed(self) -> None:
+        """A single `seed` fans out to all sub-seeds (reference: config.cpp)."""
+        if self.seed is not None:
+            s = self.seed
+            self.io.data_random_seed = s + 1
+            self.tree.feature_fraction_seed = s + 2
+            self.boosting.bagging_seed = s + 3
+            self.boosting.drop_seed = s + 4
+
+    def check_param_conflict(self) -> None:
+        """Reference: OverallConfig::CheckParamConflict, config.cpp:188-230."""
+        if self.network.num_machines > 1:
+            self.is_parallel = True
+        if self.tree_learner == "serial":
+            if self.network.num_machines > 1:
+                log.warning("num_machines>1 with tree_learner=serial; "
+                            "forcing num_machines=1")
+            self.network.num_machines = 1
+            self.is_parallel = False
+        if self.is_parallel and self.tree_learner in ("data", "voting"):
+            self.is_parallel_find_bin = True
+        if self.tree.histogram_pool_size >= 0 and self.tree_learner != "serial":
+            log.warning("histogram_pool_size is only supported by serial "
+                        "tree learner; ignoring")
+            self.tree.histogram_pool_size = -1
+        if self.objective in ("lambdarank",) and not self.objective_config.label_gain:
+            # default label gain = 2^i - 1 (reference: config.cpp)
+            self.objective_config.label_gain = [float((1 << i) - 1) for i in range(31)]
+        if self.tree.num_leaves < 2:
+            log.fatal("num_leaves must be >= 2")
+
+
+def key_alias_transform(params: Dict[str, Any]) -> Dict[str, Any]:
+    """Apply aliases; explicit (non-alias) keys win on conflict
+    (reference: config.h:470-482)."""
+    out: Dict[str, Any] = {}
+    aliased: Dict[str, Any] = {}
+    for key, value in params.items():
+        k = str(key)
+        if k in ALIAS_TABLE:
+            aliased[ALIAS_TABLE[k]] = value
+        else:
+            out[k] = value
+    for key, value in aliased.items():
+        if key not in out:
+            out[key] = value
+    return out
+
+
+def params_str2map(text: str) -> Dict[str, str]:
+    """Parse 'k1=v1 k2=v2' strings (reference: Common::Str2Map usage in c_api)."""
+    out: Dict[str, str] = {}
+    for token in text.replace("\n", " ").split():
+        if "=" in token:
+            k, v = token.split("=", 1)
+            out[k.strip()] = v.strip()
+    return out

@@ -1,0 +1,285 @@
+"""Binned training matrix + metadata.
+
+TPU-native re-design of the reference Dataset stack
+(`include/LightGBM/dataset.h:280-570`, `src/io/dataset.cpp`):
+
+Instead of per-feature-group Bin objects with dense/sparse/4-bit variants
+(dense_bin.hpp / sparse_bin.hpp / ordered_sparse_bin.hpp), the whole
+training set is ONE dense `uint8`/`int32` matrix `[num_data, num_features]`
+of bin indices, resident in HBM for the entire run — the analogue of the
+GPU learner's `Feature4` packed device matrix (gpu_tree_learner.cpp:385-441)
+generalized to the native layout XLA tiles best. Sparse features are made
+dense by binning (a bin index per row costs 1 byte regardless of sparsity);
+Exclusive Feature Bundling further collapses mutually-exclusive sparse
+columns (dataset.cpp:66-211) so width stays manageable.
+
+Metadata mirrors `dataset.h:36-248`: label, weights, query boundaries,
+query weights, init score.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from . import log
+from .binning import (BIN_CATEGORICAL, BIN_NUMERICAL, BinMapper,
+                      find_bin_mappers)
+
+_BINARY_MAGIC = b"lightgbm_tpu.dataset.v1\n"
+
+
+class Metadata:
+    """Labels / weights / query info (reference: Metadata, dataset.h:36-248)."""
+
+    def __init__(self, num_data: int = 0):
+        self.num_data = num_data
+        self.label: Optional[np.ndarray] = None
+        self.weights: Optional[np.ndarray] = None
+        self.query_boundaries: Optional[np.ndarray] = None
+        self.query_weights: Optional[np.ndarray] = None
+        self.init_score: Optional[np.ndarray] = None
+
+    def set_label(self, label: Sequence[float]) -> None:
+        arr = np.asarray(label, dtype=np.float32).ravel()
+        if self.num_data and len(arr) != self.num_data:
+            log.fatal("Length of label (%d) != num_data (%d)" % (len(arr), self.num_data))
+        self.label = arr
+        self.num_data = len(arr)
+
+    def set_weights(self, weights: Optional[Sequence[float]]) -> None:
+        if weights is None:
+            self.weights = None
+            return
+        arr = np.asarray(weights, dtype=np.float32).ravel()
+        if self.num_data and len(arr) != self.num_data:
+            log.fatal("Length of weights (%d) != num_data (%d)" % (len(arr), self.num_data))
+        self.weights = arr
+        self._update_query_weights()
+
+    def set_group(self, group: Optional[Sequence[int]]) -> None:
+        """`group` is per-query sizes; converted to boundaries
+        (reference: Metadata::SetQuery, metadata.cpp)."""
+        if group is None:
+            self.query_boundaries = None
+            self.query_weights = None
+            return
+        sizes = np.asarray(group, dtype=np.int64).ravel()
+        bounds = np.zeros(len(sizes) + 1, dtype=np.int64)
+        np.cumsum(sizes, out=bounds[1:])
+        if self.num_data and bounds[-1] != self.num_data:
+            log.fatal("Sum of query counts (%d) != num_data (%d)" % (bounds[-1], self.num_data))
+        self.query_boundaries = bounds
+        self._update_query_weights()
+
+    def set_init_score(self, init_score: Optional[Sequence[float]]) -> None:
+        if init_score is None:
+            self.init_score = None
+            return
+        self.init_score = np.asarray(init_score, dtype=np.float64).ravel()
+
+    def _update_query_weights(self) -> None:
+        # mean of row weights per query (reference: metadata.cpp query weights)
+        if self.weights is not None and self.query_boundaries is not None:
+            nq = len(self.query_boundaries) - 1
+            qw = np.zeros(nq, dtype=np.float32)
+            for i in range(nq):
+                s, e = self.query_boundaries[i], self.query_boundaries[i + 1]
+                qw[i] = self.weights[s:e].mean() if e > s else 0.0
+            self.query_weights = qw
+
+    @property
+    def num_queries(self) -> int:
+        return 0 if self.query_boundaries is None else len(self.query_boundaries) - 1
+
+
+class Dataset:
+    """The binned training matrix (reference: Dataset, dataset.h:280-570).
+
+    Attributes:
+      binned:  `[num_data, num_features]` int32/uint8 bin indices (dense, HBM-ready)
+      mappers: per-feature BinMapper
+      metadata: labels / weights / queries
+      feature_names: column names
+      used_features: indices of non-trivial features in the ORIGINAL column
+        space (trivial features are dropped from `binned`, as the reference
+        drops them from feature groups, dataset.cpp:212-260)
+    """
+
+    def __init__(self):
+        self.binned: Optional[np.ndarray] = None
+        self.raw: Optional[np.ndarray] = None  # kept optionally for valid-set binning
+        self.mappers: List[BinMapper] = []
+        self.metadata = Metadata()
+        self.feature_names: List[str] = []
+        self.used_features: List[int] = []
+        self.num_total_features: int = 0
+        self.max_bin: int = 255
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_numpy(cls, data: np.ndarray, label: Optional[Sequence[float]] = None,
+                   max_bin: int = 255, min_data_in_bin: int = 3,
+                   min_split_data: int = 0,
+                   bin_construct_sample_cnt: int = 200000,
+                   data_random_seed: int = 1,
+                   categorical_features: Optional[Sequence[int]] = None,
+                   use_missing: bool = True, zero_as_missing: bool = False,
+                   feature_names: Optional[Sequence[str]] = None,
+                   weight: Optional[Sequence[float]] = None,
+                   group: Optional[Sequence[int]] = None,
+                   init_score: Optional[Sequence[float]] = None,
+                   reference: Optional["Dataset"] = None,
+                   keep_raw: bool = False) -> "Dataset":
+        """Build a Dataset from a dense float matrix.
+
+        When `reference` is given, its BinMappers are reused so validation
+        data lands in the same bin space (reference: Dataset::CreateValid,
+        dataset.cpp + python basic.py set_reference chain).
+        """
+        data = np.asarray(data)
+        if data.ndim != 2:
+            log.fatal("Dataset data must be 2-dimensional")
+        n, f = data.shape
+        ds = cls()
+        ds.num_total_features = f
+        ds.max_bin = max_bin if reference is None else reference.max_bin
+        ds.feature_names = list(feature_names) if feature_names is not None else \
+            [f"Column_{i}" for i in range(f)]
+
+        if reference is not None:
+            if f != reference.num_total_features:
+                log.fatal("Validation data feature count (%d) != train (%d)"
+                          % (f, reference.num_total_features))
+            ds.mappers = reference.mappers
+            ds.used_features = reference.used_features
+        else:
+            ds.mappers = find_bin_mappers(
+                data.astype(np.float64, copy=False), max_bin, min_data_in_bin,
+                min_split_data, bin_construct_sample_cnt, data_random_seed,
+                categorical_features, use_missing, zero_as_missing)
+            ds.used_features = [j for j, m in enumerate(ds.mappers) if not m.is_trivial]
+            if not ds.used_features:
+                log.warning("All features are trivial (constant); "
+                            "model will predict a constant")
+
+        cols = []
+        for j in ds.used_features:
+            cols.append(ds.mappers[j].values_to_bins(
+                np.asarray(data[:, j], dtype=np.float64)))
+        ds.binned = (np.stack(cols, axis=1).astype(np.int32) if cols
+                     else np.zeros((n, 0), dtype=np.int32))
+        if keep_raw:
+            ds.raw = data
+        ds.metadata = Metadata(n)
+        if label is not None:
+            ds.metadata.set_label(label)
+        if weight is not None:
+            ds.metadata.set_weights(weight)
+        if group is not None:
+            ds.metadata.set_group(group)
+        if init_score is not None:
+            ds.metadata.set_init_score(init_score)
+        return ds
+
+    # ------------------------------------------------------------------
+    @property
+    def num_data(self) -> int:
+        return 0 if self.binned is None else self.binned.shape[0]
+
+    @property
+    def num_features(self) -> int:
+        """Number of used (non-trivial) features."""
+        return 0 if self.binned is None else self.binned.shape[1]
+
+    def feature_mapper(self, inner_idx: int) -> BinMapper:
+        return self.mappers[self.used_features[inner_idx]]
+
+    def real_feature_index(self, inner_idx: int) -> int:
+        return self.used_features[inner_idx]
+
+    def num_bins_per_feature(self) -> np.ndarray:
+        return np.asarray([self.feature_mapper(j).num_bin
+                           for j in range(self.num_features)], dtype=np.int32)
+
+    def max_num_bin(self) -> int:
+        nb = self.num_bins_per_feature()
+        return int(nb.max()) if len(nb) else 1
+
+    def feature_meta_arrays(self) -> Dict[str, np.ndarray]:
+        """Static per-feature metadata consumed by the device split finder."""
+        f = self.num_features
+        num_bin = np.zeros(f, dtype=np.int32)
+        missing_type = np.zeros(f, dtype=np.int32)
+        default_bin = np.zeros(f, dtype=np.int32)
+        is_categorical = np.zeros(f, dtype=bool)
+        for j in range(f):
+            m = self.feature_mapper(j)
+            num_bin[j] = m.num_bin
+            missing_type[j] = m.missing_type
+            default_bin[j] = m.default_bin
+            is_categorical[j] = m.bin_type == BIN_CATEGORICAL
+        return {"num_bin": num_bin, "missing_type": missing_type,
+                "default_bin": default_bin, "is_categorical": is_categorical}
+
+    # ------------------------------------------------------------------
+    # binary serialization (reference: Dataset::SaveBinaryFile, dataset.h:386,
+    # DatasetLoader::LoadFromBinFile, dataset_loader.cpp:265-430)
+    def save_binary(self, filename: str) -> None:
+        import json
+        meta = {
+            "feature_names": self.feature_names,
+            "used_features": self.used_features,
+            "num_total_features": self.num_total_features,
+            "max_bin": self.max_bin,
+            "mappers": [m.to_dict() for m in self.mappers],
+        }
+        meta_bytes = json.dumps(meta).encode()
+        with open(filename, "wb") as fh:
+            fh.write(_BINARY_MAGIC)
+            fh.write(struct.pack("<q", len(meta_bytes)))
+            fh.write(meta_bytes)
+            for arr, code in [(self.binned, b"B"), (self.metadata.label, b"L"),
+                              (self.metadata.weights, b"W"),
+                              (self.metadata.query_boundaries, b"Q"),
+                              (self.metadata.init_score, b"I")]:
+                if arr is None:
+                    fh.write(b"N")
+                    continue
+                fh.write(code)
+                header = np.lib.format.header_data_from_array_1_0(np.asarray(arr))
+                np.save(fh, np.asarray(arr), allow_pickle=False)
+        log.info("Saved binary dataset to %s", filename)
+
+    @classmethod
+    def load_binary(cls, filename: str) -> "Dataset":
+        import json
+        ds = cls()
+        with open(filename, "rb") as fh:
+            magic = fh.read(len(_BINARY_MAGIC))
+            if magic != _BINARY_MAGIC:
+                log.fatal("%s is not a lightgbm_tpu binary dataset" % filename)
+            (mlen,) = struct.unpack("<q", fh.read(8))
+            meta = json.loads(fh.read(mlen).decode())
+            ds.feature_names = meta["feature_names"]
+            ds.used_features = [int(x) for x in meta["used_features"]]
+            ds.num_total_features = int(meta["num_total_features"])
+            ds.max_bin = int(meta["max_bin"])
+            ds.mappers = [BinMapper.from_dict(d) for d in meta["mappers"]]
+            arrays = []
+            for _ in range(5):
+                code = fh.read(1)
+                arrays.append(None if code == b"N" else np.load(fh, allow_pickle=False))
+        ds.binned, label, weights, qb, init = arrays
+        ds.metadata = Metadata(0 if ds.binned is None else ds.binned.shape[0])
+        if label is not None:
+            ds.metadata.set_label(label)
+        if weights is not None:
+            ds.metadata.set_weights(weights)
+        if qb is not None:
+            ds.metadata.query_boundaries = qb
+            ds.metadata._update_query_weights()
+        if init is not None:
+            ds.metadata.set_init_score(init)
+        return ds

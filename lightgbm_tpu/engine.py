@@ -1,0 +1,226 @@
+"""train() / cv() — the training entrypoints.
+
+Mirrors the reference python-package engine
+(`python-package/lightgbm/engine.py` — train at :18, cv at :310) including
+the callback protocol (before/after iteration, engine.py:190-226) and
+EarlyStopException unwinding (engine.py:216-218).
+"""
+from __future__ import annotations
+
+import collections
+import copy
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from . import callback as callback_mod
+from . import log
+from .basic import Booster, Dataset, LightGBMError
+from .config import key_alias_transform
+
+
+def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
+          valid_sets=None, valid_names=None, fobj=None, feval=None,
+          init_model=None, feature_name: str = "auto",
+          categorical_feature: str = "auto", early_stopping_rounds: Optional[int] = None,
+          evals_result: Optional[dict] = None, verbose_eval=True,
+          learning_rates=None, keep_training_booster: bool = False,
+          callbacks: Optional[List] = None) -> Booster:
+    """Train one model (reference: engine.py:18-230)."""
+    params = key_alias_transform(dict(params))
+    num_boost_round = int(params.pop("num_iterations", num_boost_round))
+    if "early_stopping_round" in params:
+        early_stopping_rounds = int(params.pop("early_stopping_round"))
+    if fobj is not None:
+        params["objective"] = "none"
+    if feature_name != "auto":
+        train_set.feature_name = feature_name
+    if categorical_feature != "auto":
+        train_set.categorical_feature = categorical_feature
+
+    booster = Booster(params=params, train_set=train_set)
+    if init_model is not None:
+        init_booster = init_model if isinstance(init_model, Booster) \
+            else Booster(model_file=init_model, params=params)
+        # continued training: seed scores with the loaded model's predictions
+        _continue_from(booster, init_booster, train_set)
+
+    valid_sets = valid_sets or []
+    if isinstance(valid_sets, Dataset):
+        valid_sets = [valid_sets]
+    valid_names = valid_names or [f"valid_{i}" for i in range(len(valid_sets))]
+    is_valid_contain_train = False
+    train_data_name = "training"
+    for i, vs in enumerate(valid_sets):
+        if vs is train_set:
+            is_valid_contain_train = True
+            train_data_name = valid_names[i]
+            continue
+        booster.add_valid(vs, valid_names[i])
+    if is_valid_contain_train:
+        booster._inner.config.metric.is_provide_training_metric = True
+
+    # assemble callbacks (engine.py:150-188)
+    callbacks = list(callbacks or [])
+    if verbose_eval is True:
+        callbacks.append(callback_mod.print_evaluation())
+    elif isinstance(verbose_eval, int) and verbose_eval and verbose_eval is not False:
+        callbacks.append(callback_mod.print_evaluation(int(verbose_eval)))
+    if early_stopping_rounds is not None and early_stopping_rounds > 0:
+        callbacks.append(callback_mod.early_stopping(
+            early_stopping_rounds, verbose=bool(verbose_eval)))
+    if learning_rates is not None:
+        callbacks.append(callback_mod.reset_parameter(learning_rate=learning_rates))
+    if evals_result is not None:
+        callbacks.append(callback_mod.record_evaluation(evals_result))
+    callbacks_before = [cb for cb in callbacks if getattr(cb, "before_iteration", False)]
+    callbacks_after = [cb for cb in callbacks if not getattr(cb, "before_iteration", False)]
+    callbacks_before.sort(key=lambda cb: getattr(cb, "order", 0))
+    callbacks_after.sort(key=lambda cb: getattr(cb, "order", 0))
+
+    # main loop (engine.py:190-226)
+    finished_iter = num_boost_round
+    try:
+        for i in range(num_boost_round):
+            for cb in callbacks_before:
+                cb(callback_mod.CallbackEnv(model=booster, params=params,
+                                            iteration=i, begin_iteration=0,
+                                            end_iteration=num_boost_round,
+                                            evaluation_result_list=None))
+            stop = booster.update(fobj=fobj)
+            if stop:
+                finished_iter = i
+                break
+            evaluation_result_list = []
+            if is_valid_contain_train:
+                evaluation_result_list.extend(booster.eval_train(feval))
+            if valid_sets:
+                evaluation_result_list.extend(booster.eval_valid(feval))
+            try:
+                for cb in callbacks_after:
+                    cb(callback_mod.CallbackEnv(model=booster, params=params,
+                                                iteration=i, begin_iteration=0,
+                                                end_iteration=num_boost_round,
+                                                evaluation_result_list=evaluation_result_list))
+            except callback_mod.EarlyStopException as e:
+                booster.best_iteration = e.best_iteration + 1
+                finished_iter = booster.best_iteration
+                for data_name, eval_name, score, _ in e.best_score:
+                    booster.best_score.setdefault(data_name, collections.OrderedDict())
+                    booster.best_score[data_name][eval_name] = score
+                break
+    except KeyboardInterrupt:
+        raise
+    return booster
+
+
+def _continue_from(booster: Booster, init_booster: Booster, train_set: Dataset):
+    """Seed a new booster's state from a loaded model (reference:
+    boosting.cpp:29-62 + application.cpp:112-116 init-score path)."""
+    inner = booster._inner
+    init_inner = init_booster._inner
+    inner.models = copy.deepcopy(init_inner.models)
+    inner.iter_ = init_inner.iter_
+    # the fresh booster's own boost_from_average must be undone — the loaded
+    # model's trees (plus its recorded bias) already carry the base score
+    if inner.init_score_bias != 0.0:
+        inner._score = inner._score - inner.init_score_bias
+    inner.init_score_bias = init_inner.init_score_bias
+    # models from reference-format text lack bin-space metadata; rebuild it
+    # from the training dataset's mappers before binned replay
+    for tree in inner.models:
+        if tree.num_leaves > 1 and not tree.has_bin_metadata:
+            tree.attach_bin_metadata(inner.train_data)
+    from .boosting.gbdt import _jit_forest_binned
+    from .ops.predict import stack_trees
+    k = inner.num_tree_per_iteration
+    inner._score = inner._score + init_inner.init_score_bias
+    for cls in range(k):
+        class_trees = [t for i, t in enumerate(inner.models) if i % k == cls
+                       and t.num_leaves > 1]
+        if class_trees:
+            inner._score = inner._score.at[cls].add(
+                _jit_forest_binned(stack_trees(class_trees), inner._binned))
+
+
+def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
+       folds=None, nfold: int = 5, stratified: bool = True, shuffle: bool = True,
+       metrics=None, fobj=None, feval=None, init_model=None,
+       feature_name: str = "auto", categorical_feature: str = "auto",
+       early_stopping_rounds: Optional[int] = None, fpreproc=None,
+       verbose_eval=None, show_stdv: bool = True, seed: int = 0,
+       callbacks: Optional[List] = None) -> Dict[str, List[float]]:
+    """K-fold cross-validation (reference: engine.py:310-464)."""
+    params = key_alias_transform(dict(params))
+    num_boost_round = int(params.pop("num_iterations", num_boost_round))
+    if metrics is not None:
+        params["metric"] = metrics
+    inner_full = train_set._lazy_init()
+    n = inner_full.num_data
+    label = np.asarray(inner_full.metadata.label)
+
+    rng = np.random.RandomState(seed)
+    if folds is None:
+        idx = np.arange(n)
+        if shuffle:
+            rng.shuffle(idx)
+        if stratified and params.get("objective", "").startswith(("binary", "multiclass")):
+            # stratified assignment by label
+            folds_idx = [[] for _ in range(nfold)]
+            for lab in np.unique(label):
+                lab_idx = idx[label[idx] == lab]
+                for i, r in enumerate(lab_idx):
+                    folds_idx[i % nfold].append(r)
+            folds = [(np.setdiff1d(idx, np.asarray(f)), np.asarray(sorted(f)))
+                     for f in folds_idx]
+        else:
+            splits = np.array_split(idx, nfold)
+            folds = [(np.setdiff1d(idx, s), np.sort(s)) for s in splits]
+
+    boosters = []
+    for train_idx, test_idx in folds:
+        tr = train_set.subset(train_idx)
+        te = train_set.subset(test_idx)
+        if fpreproc is not None:
+            tr, te, params = fpreproc(tr, te, params.copy())
+        b = Booster(params=dict(params), train_set=tr)
+        te.set_reference(tr)
+        b.add_valid(te, "valid")
+        boosters.append(b)
+
+    results = collections.defaultdict(list)
+    try:
+        for i in range(num_boost_round):
+            agg: Dict[str, List[float]] = collections.defaultdict(list)
+            bigger: Dict[str, bool] = {}
+            for b in boosters:
+                b.update(fobj=fobj)
+                for _, name, val, ib in b.eval_valid(feval):
+                    agg[name].append(val)
+                    bigger[name] = ib
+            for name, vals in agg.items():
+                results[name + "-mean"].append(float(np.mean(vals)))
+                results[name + "-stdv"].append(float(np.std(vals)))
+            if verbose_eval:
+                msg = "\t".join(f"cv_agg {k}: {v[-1]:g}" for k, v in results.items()
+                                if k.endswith("-mean"))
+                log.info("[%d]\t%s", i + 1, msg)
+            if early_stopping_rounds and i >= early_stopping_rounds:
+                keys = [k for k in results if k.endswith("-mean")]
+                stop = True
+                for k in keys:
+                    hist = results[k]
+                    base = k[:-5]
+                    if bigger.get(base, False):
+                        best = int(np.argmax(hist))
+                    else:
+                        best = int(np.argmin(hist))
+                    if i - best < early_stopping_rounds:
+                        stop = False
+                if stop:
+                    for k in list(results.keys()):
+                        results[k] = results[k][:best + 1]
+                    break
+    except callback_mod.EarlyStopException:
+        pass
+    return dict(results)

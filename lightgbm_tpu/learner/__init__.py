@@ -1,0 +1,1 @@
+from .grow import GrowerConfig, TreeGrowerState, grow_tree, make_grower  # noqa: F401

@@ -1,0 +1,362 @@
+"""Leaf-wise tree growth as a single jitted program.
+
+TPU-native re-design of the reference SerialTreeLearner
+(`src/treelearner/serial_tree_learner.cpp:152-583`). The reference grows a
+tree with per-leaf dynamic row partitions (DataPartition), a histogram LRU
+pool, and host loops. Here the entire `num_leaves-1` split loop is ONE
+`lax.fori_loop` under jit with fixed shapes:
+
+- the row partition is a `leaf_id[N]` vector (no index shuffling; split
+  application is a vectorized where — replaces data_partition.hpp:94-170);
+- all active-leaf histograms live in a dense `[L, F, B, 3]` HBM pool
+  (replaces the size-bounded HistogramPool, feature_histogram.hpp:380-548 —
+  HBM is plentiful, rematerialization unnecessary);
+- the smaller child's histogram is built by masked reduction; the larger is
+  parent − smaller (the subtraction trick, serial_tree_learner.cpp:482-487);
+- best-split finding is the vectorized [F, B] scan (ops/split.py) followed
+  by an argmax over features, replacing per-feature OMP loops
+  (serial_tree_learner.cpp:451-516).
+
+`lax.cond` keeps iterations after growth stops (all gains <= 0) nearly
+free. One compile per (N, F, B, L, hyperparam) signature, reused across
+trees and boosting iterations.
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import histogram as hist_ops
+from ..ops import split as split_ops
+from ..ops.predict import DeviceTree
+from ..ops.split import leaf_output
+
+
+from typing import Optional
+
+
+class GrowerConfig(NamedTuple):
+    """Static hyperparameters baked into the compiled grower.
+
+    Distributed axes (SURVEY.md §2.5, §3.5 — the reference's tree_learner
+    matrix mapped onto a jax Mesh):
+    - data_axis: mesh axis name over which ROWS are sharded. Histograms are
+      psum'd over it — the collective replacing Network::ReduceScatter +
+      Allgather of HistogramBinEntry buffers (data_parallel_tree_learner
+      .cpp:148-163). All other state is computed redundantly per shard.
+    - feature_axis: mesh axis name over which FEATURES are sharded (data
+      replicated). Each shard builds histograms/splits only for its feature
+      block; the global best split is an allreduce-argmax on (gain, payload)
+      — replacing SyncUpGlobalBestSplit (parallel_tree_learner.h:184-207).
+    - num_feature_shards: size of feature_axis (features must be padded to
+      a multiple of it host-side).
+    """
+    num_leaves: int
+    max_bins: int
+    chunk: int
+    lambda_l1: float
+    lambda_l2: float
+    min_gain_to_split: float
+    min_data_in_leaf: int
+    min_sum_hessian_in_leaf: float
+    max_depth: int
+    data_axis: Optional[str] = None
+    feature_axis: Optional[str] = None
+    num_feature_shards: int = 1
+
+
+class TreeGrowerState(NamedTuple):
+    leaf_id: jnp.ndarray          # [N] i32 (-1 = padded/inactive row)
+    # per-leaf aggregates [L]
+    sum_g: jnp.ndarray
+    sum_h: jnp.ndarray
+    count: jnp.ndarray
+    leaf_value: jnp.ndarray
+    leaf_depth: jnp.ndarray
+    leaf_parent: jnp.ndarray
+    # per-leaf best-split cache [L]
+    best_gain: jnp.ndarray
+    best_feature: jnp.ndarray
+    best_threshold: jnp.ndarray
+    best_default_left: jnp.ndarray
+    best_is_cat: jnp.ndarray
+    best_left_g: jnp.ndarray
+    best_left_h: jnp.ndarray
+    best_left_c: jnp.ndarray
+    # histogram pool [L, F, B, 3]
+    hist_pool: jnp.ndarray
+    # tree node arrays [L-1]
+    node_feature: jnp.ndarray
+    node_threshold: jnp.ndarray
+    node_default_left: jnp.ndarray
+    node_is_cat: jnp.ndarray
+    node_left: jnp.ndarray
+    node_right: jnp.ndarray
+    node_gain: jnp.ndarray
+    node_value: jnp.ndarray
+    node_count: jnp.ndarray
+    num_leaves_used: jnp.ndarray  # scalar i32
+
+
+def _leaf_best_split(hist, sum_g, sum_h, count, depth, feature_mask, fmeta, cfg):
+    """Best (gain, feature, ...) for one leaf from its (local) histogram.
+
+    Mirrors FindBestSplitsFromHistograms (serial_tree_learner.cpp:451-516):
+    per-feature best via the vectorized scan, then argmax over features with
+    the per-tree feature_fraction mask and max_depth guard applied. Under
+    feature parallelism the argmax covers only this shard's features and is
+    then combined across shards by an allreduce-argmax (the reference's
+    SyncUpGlobalBestSplit, parallel_tree_learner.h:184-207)."""
+    res = split_ops.find_best_splits(
+        hist, sum_g, sum_h, count,
+        fmeta["num_bin"], fmeta["missing_type"], fmeta["default_bin"],
+        fmeta["is_categorical"],
+        lambda_l1=cfg.lambda_l1, lambda_l2=cfg.lambda_l2,
+        min_gain_to_split=cfg.min_gain_to_split,
+        min_data_in_leaf=cfg.min_data_in_leaf,
+        min_sum_hessian_in_leaf=cfg.min_sum_hessian_in_leaf)
+    gains = jnp.where(feature_mask, res.gain, -jnp.inf)
+    if cfg.max_depth > 0:
+        gains = jnp.where(depth + 1 > cfg.max_depth, -jnp.inf, gains)
+    best_f = jnp.argmax(gains).astype(jnp.int32)
+    pick = lambda arr: arr[best_f]
+    vals = (pick(gains), best_f, pick(res.threshold), pick(res.default_left),
+            pick(res.is_categorical), pick(res.left_sum_g), pick(res.left_sum_h),
+            pick(res.left_count))
+    if cfg.feature_axis is None:
+        return vals
+    # allreduce-argmax across feature shards: winner shard's payload wins,
+    # ties broken toward the lowest shard index (the reference's reducer
+    # compares gains then keeps the first, parallel_tree_learner.h:190-205)
+    ax = cfg.feature_axis
+    fl = hist.shape[0]
+    fidx = jax.lax.axis_index(ax)
+    gain, feat, thr, dl, cat, lg, lh, lc = vals
+    feat_global = feat + fidx * fl
+    gmax = jax.lax.pmax(gain, ax)
+    win = (gain == gmax) & jnp.isfinite(gmax)
+    wrank = jax.lax.pmin(jnp.where(win, fidx, jnp.int32(1 << 30)), ax)
+    sel = win & (fidx == wrank)
+
+    def bcast(x):
+        xi = x.astype(jnp.int32) if x.dtype == jnp.bool_ else x
+        z = jnp.where(sel, xi, jnp.zeros_like(xi))
+        out = jax.lax.psum(z, ax)
+        return out > 0 if x.dtype == jnp.bool_ else out
+
+    return (gmax, bcast(feat_global), bcast(thr), bcast(dl), bcast(cat),
+            bcast(lg), bcast(lh), bcast(lc))
+
+
+def _set_leaf_best(state: TreeGrowerState, leaf, vals) -> TreeGrowerState:
+    gain, feat, thr, dl, cat, lg, lh, lc = vals
+    return state._replace(
+        best_gain=state.best_gain.at[leaf].set(gain),
+        best_feature=state.best_feature.at[leaf].set(feat),
+        best_threshold=state.best_threshold.at[leaf].set(thr),
+        best_default_left=state.best_default_left.at[leaf].set(dl),
+        best_is_cat=state.best_is_cat.at[leaf].set(cat),
+        best_left_g=state.best_left_g.at[leaf].set(lg),
+        best_left_h=state.best_left_h.at[leaf].set(lh),
+        best_left_c=state.best_left_c.at[leaf].set(lc),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
+              row_weight: jnp.ndarray, feature_mask: jnp.ndarray,
+              fmeta_num_bin: jnp.ndarray, fmeta_missing: jnp.ndarray,
+              fmeta_default_bin: jnp.ndarray, fmeta_is_cat: jnp.ndarray,
+              cfg: GrowerConfig):
+    """Grow one leaf-wise tree.
+
+    Args:
+      binned: [N, F] i32 bin indices, rows padded to a multiple of cfg.chunk
+        (padded rows must have row_weight 0).
+      grad/hess: [N] f32 gradients/hessians (GOSS amplification pre-applied
+        via row_weight).
+      row_weight: [N] f32 bagging weight (0 = excluded, GOSS weights > 0).
+      feature_mask: [F] bool per-tree feature_fraction sample.
+    Returns: (DeviceTree fields without real thresholds, leaf_id) — the host
+      wraps them and converts bin thresholds to raw-space values.
+    """
+    n, f = binned.shape
+    L = cfg.num_leaves
+    B = cfg.max_bins
+    fmeta = {"num_bin": fmeta_num_bin, "missing_type": fmeta_missing,
+             "default_bin": fmeta_default_bin, "is_categorical": fmeta_is_cat}
+
+    # feature parallelism: this shard builds histograms/splits only for its
+    # contiguous feature block; routing still uses the full (replicated)
+    # matrix (feature_parallel_tree_learner.cpp:31-69 — data replicated,
+    # features partitioned per machine)
+    if cfg.feature_axis is not None:
+        fl = f // cfg.num_feature_shards
+        fstart = jax.lax.axis_index(cfg.feature_axis) * fl
+        local_binned = jax.lax.dynamic_slice_in_dim(binned, fstart, fl, axis=1)
+        local_fmeta = {k: jax.lax.dynamic_slice_in_dim(v, fstart, fl)
+                       for k, v in fmeta.items()}
+        local_fmask = jax.lax.dynamic_slice_in_dim(feature_mask, fstart, fl)
+    else:
+        fl = f
+        local_binned, local_fmeta, local_fmask = binned, fmeta, feature_mask
+
+    def build_hist(w3):
+        """Local histogram + data-axis reduction (the ReduceScatter seam,
+        data_parallel_tree_learner.cpp:148-163 — XLA picks the schedule)."""
+        h = hist_ops.leaf_histogram(local_binned, w3, B, cfg.chunk)
+        if cfg.data_axis is not None:
+            h = jax.lax.psum(h, cfg.data_axis)
+        return h
+
+    # all rows start in the root; excluded (bagged-out / padded) rows carry
+    # row_weight 0 so they route through splits but contribute nothing
+    leaf_id = jnp.zeros(n, jnp.int32)
+
+    # --- root (BeforeTrain: serial_tree_learner.cpp:234-323) ------------
+    w3 = jnp.stack([grad * row_weight, hess * row_weight,
+                    (row_weight > 0).astype(jnp.float32)], axis=-1)
+    root_hist = build_hist(w3)
+    # global leaf sums: the reference Allreduces (cnt, sum_g, sum_h)
+    # (data_parallel_tree_learner.cpp:117-145); summing any feature's bins
+    # of the already-reduced histogram gives the same totals
+    root_tot = root_hist[0].sum(axis=0)
+    root_g, root_h, root_c = root_tot[0], root_tot[1], root_tot[2]
+
+    neg_inf = jnp.float32(-jnp.inf)
+    state = TreeGrowerState(
+        leaf_id=leaf_id,
+        sum_g=jnp.zeros(L, jnp.float32).at[0].set(root_g),
+        sum_h=jnp.zeros(L, jnp.float32).at[0].set(root_h),
+        count=jnp.zeros(L, jnp.float32).at[0].set(root_c),
+        leaf_value=jnp.zeros(L, jnp.float32).at[0].set(
+            leaf_output(root_g, root_h, cfg.lambda_l1, cfg.lambda_l2)),
+        leaf_depth=jnp.zeros(L, jnp.int32),
+        leaf_parent=jnp.full(L, -1, jnp.int32),
+        best_gain=jnp.full(L, neg_inf),
+        best_feature=jnp.zeros(L, jnp.int32),
+        best_threshold=jnp.zeros(L, jnp.int32),
+        best_default_left=jnp.zeros(L, bool),
+        best_is_cat=jnp.zeros(L, bool),
+        best_left_g=jnp.zeros(L, jnp.float32),
+        best_left_h=jnp.zeros(L, jnp.float32),
+        best_left_c=jnp.zeros(L, jnp.float32),
+        hist_pool=jnp.zeros((L, fl, B, 3), jnp.float32).at[0].set(root_hist),
+        node_feature=jnp.zeros(L - 1, jnp.int32),
+        node_threshold=jnp.zeros(L - 1, jnp.int32),
+        node_default_left=jnp.zeros(L - 1, bool),
+        node_is_cat=jnp.zeros(L - 1, bool),
+        node_left=jnp.zeros(L - 1, jnp.int32),
+        node_right=jnp.zeros(L - 1, jnp.int32),
+        node_gain=jnp.zeros(L - 1, jnp.float32),
+        node_value=jnp.zeros(L - 1, jnp.float32),
+        node_count=jnp.zeros(L - 1, jnp.float32),
+        num_leaves_used=jnp.int32(1),
+    )
+    state = _set_leaf_best(state, 0, _leaf_best_split(
+        root_hist, root_g, root_h, root_c, jnp.int32(0), local_fmask,
+        local_fmeta, cfg))
+
+    # --- split loop (Train: serial_tree_learner.cpp:152-205) ------------
+    def body(i, state: TreeGrowerState) -> TreeGrowerState:
+        best_leaf = jnp.argmax(state.best_gain).astype(jnp.int32)
+        should_split = state.best_gain[best_leaf] > 0.0
+
+        def do_split(state: TreeGrowerState) -> TreeGrowerState:
+            l = best_leaf
+            new_leaf = i + 1
+            feat = state.best_feature[l]
+            thr = state.best_threshold[l]
+            dl = state.best_default_left[l]
+            cat = state.best_is_cat[l]
+            lg, lh, lc = state.best_left_g[l], state.best_left_h[l], state.best_left_c[l]
+            pg, ph, pc = state.sum_g[l], state.sum_h[l], state.count[l]
+            rg, rh, rc = pg - lg, ph - lh, pc - lc
+
+            # route rows (replaces DataPartition::Split, data_partition.hpp:94)
+            col = jax.lax.dynamic_index_in_dim(binned, feat, axis=1, keepdims=False)
+            missing = fmeta["missing_type"][feat]
+            nan_bin = fmeta["num_bin"][feat] - 1
+            dbin = fmeta["default_bin"][feat]
+            from ..binning import MISSING_NAN, MISSING_ZERO
+            is_missing = (((missing == MISSING_NAN) & (col == nan_bin))
+                          | ((missing == MISSING_ZERO) & (col == dbin)))
+            numeric_left = jnp.where(is_missing, dl, col <= thr)
+            go_left = jnp.where(cat, col == thr, numeric_left)
+            in_leaf = state.leaf_id == l
+            leaf_id = jnp.where(in_leaf & ~go_left, new_leaf, state.leaf_id)
+
+            # smaller-child histogram + subtraction
+            smaller_is_left = lc <= rc
+            smaller_leaf = jnp.where(smaller_is_left, l, new_leaf)
+            w3s = hist_ops.leaf_weights(grad, hess, leaf_id, smaller_leaf, row_weight)
+            small_hist = build_hist(w3s)
+            parent_hist = state.hist_pool[l]
+            large_hist = parent_hist - small_hist
+            left_hist = jnp.where(smaller_is_left, small_hist, large_hist)
+            right_hist = jnp.where(smaller_is_left, large_hist, small_hist)
+            hist_pool = state.hist_pool.at[l].set(left_hist).at[new_leaf].set(right_hist)
+
+            # tree bookkeeping (Tree::Split, tree.cpp:50-69)
+            parent_node = state.leaf_parent[l]
+            has_parent = parent_node >= 0
+            pn = jnp.maximum(parent_node, 0)
+            fix_left = state.node_left[pn] == ~l
+            node_left = state.node_left.at[pn].set(
+                jnp.where(has_parent & fix_left, i, state.node_left[pn]))
+            node_right = state.node_right.at[pn].set(
+                jnp.where(has_parent & ~fix_left, i, state.node_right[pn]))
+            node_left = node_left.at[i].set(~l)
+            node_right = node_right.at[i].set(~new_leaf)
+
+            depth_l = state.leaf_depth[l]
+            lv = leaf_output(lg, lh, cfg.lambda_l1, cfg.lambda_l2)
+            rv = leaf_output(rg, rh, cfg.lambda_l1, cfg.lambda_l2)
+
+            state = state._replace(
+                leaf_id=leaf_id,
+                sum_g=state.sum_g.at[l].set(lg).at[new_leaf].set(rg),
+                sum_h=state.sum_h.at[l].set(lh).at[new_leaf].set(rh),
+                count=state.count.at[l].set(lc).at[new_leaf].set(rc),
+                leaf_value=state.leaf_value.at[l].set(lv).at[new_leaf].set(rv),
+                leaf_depth=state.leaf_depth.at[l].set(depth_l + 1)
+                                           .at[new_leaf].set(depth_l + 1),
+                leaf_parent=state.leaf_parent.at[l].set(i).at[new_leaf].set(i),
+                hist_pool=hist_pool,
+                node_feature=state.node_feature.at[i].set(feat),
+                node_threshold=state.node_threshold.at[i].set(thr),
+                node_default_left=state.node_default_left.at[i].set(dl),
+                node_is_cat=state.node_is_cat.at[i].set(cat),
+                node_left=node_left,
+                node_right=node_right,
+                node_gain=state.node_gain.at[i].set(state.best_gain[l]),
+                node_value=state.node_value.at[i].set(
+                    leaf_output(pg, ph, cfg.lambda_l1, cfg.lambda_l2)),
+                node_count=state.node_count.at[i].set(pc),
+                num_leaves_used=state.num_leaves_used + 1,
+            )
+            # refresh best splits for the two children
+            state = _set_leaf_best(state, l, _leaf_best_split(
+                left_hist, lg, lh, lc, depth_l + 1, local_fmask,
+                local_fmeta, cfg))
+            state = _set_leaf_best(state, new_leaf, _leaf_best_split(
+                right_hist, rg, rh, rc, depth_l + 1, local_fmask,
+                local_fmeta, cfg))
+            return state
+
+        return jax.lax.cond(should_split, do_split, lambda s: s, state)
+
+    state = jax.lax.fori_loop(0, L - 1, body, state)
+    return state
+
+
+def make_grower(cfg: GrowerConfig):
+    """Convenience closure binding the static config."""
+    def run(binned, grad, hess, row_weight, feature_mask, fmeta):
+        return grow_tree(binned, grad, hess, row_weight, feature_mask,
+                         fmeta["num_bin"], fmeta["missing_type"],
+                         fmeta["default_bin"], fmeta["is_categorical"], cfg)
+    return run

@@ -1,0 +1,376 @@
+"""Evaluation metrics.
+
+Re-implements the reference metric factory and the full metric surface
+(`src/metric/metric.cpp:11-46` plus regression_metric.hpp,
+binary_metric.hpp, multiclass_metric.hpp, rank_metric.hpp +
+dcg_calculator.cpp, map_metric.hpp, xentropy_metric.hpp). Metrics run on
+host numpy in float64 — they are O(N) per iteration and off the device
+critical path; only scores cross the device boundary.
+
+Convention mirrored from the reference: `is_bigger_better` decides early
+stopping direction; multiclass scores arrive class-major
+`[num_class, num_data]` flattened.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import log
+from .config import Config
+from .dataset import Metadata
+
+
+class Metric:
+    name: List[str] = []
+    is_bigger_better = False
+
+    def init(self, metadata: Metadata, num_data: int) -> None:
+        self.num_data = num_data
+        self.label = np.asarray(metadata.label, np.float64) if metadata.label is not None else None
+        self.weights = np.asarray(metadata.weights, np.float64) if metadata.weights is not None else None
+        self.sum_weights = float(self.weights.sum()) if self.weights is not None else float(num_data)
+
+    def eval(self, score: np.ndarray, objective) -> List[Tuple[str, float]]:
+        raise NotImplementedError
+
+    def _avg(self, losses: np.ndarray) -> float:
+        if self.weights is not None:
+            return float(np.sum(losses * self.weights) / self.sum_weights)
+        return float(np.mean(losses))
+
+
+def _convert(score, objective):
+    if objective is not None:
+        import jax.numpy as jnp
+        return np.asarray(objective.convert_output(jnp.asarray(score)))
+    return np.asarray(score)
+
+
+class L2Metric(Metric):
+    """reference: regression_metric.hpp (L2/MSE)."""
+    def __init__(self, config=None):
+        self.name = ["l2"]
+
+    def eval(self, score, objective):
+        pred = _convert(score, objective)
+        return [(self.name[0], self._avg((self.label - pred) ** 2))]
+
+
+class RMSEMetric(L2Metric):
+    def __init__(self, config=None):
+        self.name = ["rmse"]
+
+    def eval(self, score, objective):
+        pred = _convert(score, objective)
+        return [(self.name[0], float(np.sqrt(self._avg((self.label - pred) ** 2))))]
+
+
+class L1Metric(Metric):
+    def __init__(self, config=None):
+        self.name = ["l1"]
+
+    def eval(self, score, objective):
+        pred = _convert(score, objective)
+        return [(self.name[0], self._avg(np.abs(self.label - pred)))]
+
+
+class HuberMetric(Metric):
+    def __init__(self, config: Config):
+        self.name = ["huber"]
+        self.delta = config.objective_config.huber_delta
+
+    def eval(self, score, objective):
+        pred = _convert(score, objective)
+        diff = pred - self.label
+        a = np.abs(diff)
+        loss = np.where(a <= self.delta, 0.5 * diff * diff,
+                        self.delta * (a - 0.5 * self.delta))
+        return [(self.name[0], self._avg(loss))]
+
+
+class FairMetric(Metric):
+    def __init__(self, config: Config):
+        self.name = ["fair"]
+        self.c = config.objective_config.fair_c
+
+    def eval(self, score, objective):
+        pred = _convert(score, objective)
+        x = np.abs(pred - self.label)
+        c = self.c
+        loss = c * x - c * c * np.log1p(x / c)
+        return [(self.name[0], self._avg(loss))]
+
+
+class PoissonMetric(Metric):
+    def __init__(self, config=None):
+        self.name = ["poisson"]
+
+    def eval(self, score, objective):
+        pred = _convert(score, objective)  # exp link applied
+        eps = 1e-10
+        loss = pred - self.label * np.log(np.maximum(pred, eps))
+        return [(self.name[0], self._avg(loss))]
+
+
+class BinaryLoglossMetric(Metric):
+    """reference: binary_metric.hpp (log loss via sigmoid probability)."""
+    def __init__(self, config=None):
+        self.name = ["binary_logloss"]
+
+    def eval(self, score, objective):
+        prob = _convert(score, objective)
+        eps = 1e-15
+        prob = np.clip(prob, eps, 1 - eps)
+        is_pos = self.label > 0
+        loss = np.where(is_pos, -np.log(prob), -np.log(1 - prob))
+        return [(self.name[0], self._avg(loss))]
+
+
+class BinaryErrorMetric(Metric):
+    def __init__(self, config=None):
+        self.name = ["binary_error"]
+
+    def eval(self, score, objective):
+        prob = _convert(score, objective)
+        pred_pos = prob > 0.5
+        err = (pred_pos != (self.label > 0)).astype(np.float64)
+        return [(self.name[0], self._avg(err))]
+
+
+class AUCMetric(Metric):
+    """reference: binary_metric.hpp:160-266 (weighted rank-sum AUC).
+    is_bigger_better — reference treats AUC specially in early stopping."""
+    is_bigger_better = True
+
+    def __init__(self, config=None):
+        self.name = ["auc"]
+
+    def eval(self, score, objective):
+        # AUC is monotone-invariant; raw scores suffice
+        score = np.asarray(score, np.float64)
+        w = self.weights if self.weights is not None else np.ones_like(score)
+        order = np.argsort(score, kind="mergesort")
+        s, lab, ww = score[order], self.label[order], w[order]
+        pos_w = np.where(lab > 0, ww, 0.0)
+        neg_w = np.where(lab > 0, 0.0, ww)
+        # tie-aware trapezoidal accumulation
+        total_pos = pos_w.sum()
+        total_neg = neg_w.sum()
+        if total_pos == 0 or total_neg == 0:
+            return [(self.name[0], 1.0)]
+        # group by unique score
+        _, idx_start = np.unique(s, return_index=True)
+        grp_pos = np.add.reduceat(pos_w, idx_start)
+        grp_neg = np.add.reduceat(neg_w, idx_start)
+        cum_neg_before = np.concatenate([[0.0], np.cumsum(grp_neg)[:-1]])
+        auc = np.sum(grp_pos * (cum_neg_before + 0.5 * grp_neg))
+        return [(self.name[0], float(auc / (total_pos * total_neg)))]
+
+
+class MultiLoglossMetric(Metric):
+    def __init__(self, config: Config):
+        self.name = ["multi_logloss"]
+        self.num_class = config.objective_config.num_class
+
+    def eval(self, score, objective):
+        prob = _convert(score, objective).reshape(self.num_class, -1)
+        eps = 1e-15
+        lab = self.label.astype(int)
+        p = np.clip(prob[lab, np.arange(len(lab))], eps, 1.0)
+        return [(self.name[0], self._avg(-np.log(p)))]
+
+
+class MultiErrorMetric(Metric):
+    def __init__(self, config: Config):
+        self.name = ["multi_error"]
+        self.num_class = config.objective_config.num_class
+
+    def eval(self, score, objective):
+        prob = _convert(score, objective).reshape(self.num_class, -1)
+        pred = np.argmax(prob, axis=0)
+        err = (pred != self.label.astype(int)).astype(np.float64)
+        return [(self.name[0], self._avg(err))]
+
+
+class KLDivMetric(Metric):
+    """reference: xentropy_metric.hpp (kullback_leibler)."""
+    def __init__(self, config=None):
+        self.name = ["kldiv"]
+
+    def eval(self, score, objective):
+        p = np.clip(_convert(score, objective), 1e-15, 1 - 1e-15)
+        y = np.clip(self.label, 0, 1)
+        # KL(y || p) = xent(y,p) - entropy(y)
+        xent = -(y * np.log(p) + (1 - y) * np.log(1 - p))
+        with np.errstate(divide="ignore", invalid="ignore"):
+            ent = -(np.where(y > 0, y * np.log(y), 0.0)
+                    + np.where(y < 1, (1 - y) * np.log(1 - y), 0.0))
+        return [(self.name[0], self._avg(xent - ent))]
+
+
+class CrossEntropyMetric(Metric):
+    def __init__(self, config=None):
+        self.name = ["xentropy"]
+
+    def eval(self, score, objective):
+        p = np.clip(_convert(score, objective), 1e-15, 1 - 1e-15)
+        y = np.clip(self.label, 0, 1)
+        loss = -(y * np.log(p) + (1 - y) * np.log(1 - p))
+        return [(self.name[0], self._avg(loss))]
+
+
+class CrossEntropyLambdaMetric(Metric):
+    def __init__(self, config=None):
+        self.name = ["xentlambda"]
+
+    def eval(self, score, objective):
+        # hhat in (0, inf); loss per xentropy_metric.hpp:240-330
+        hhat = np.maximum(_convert(score, objective), 1e-15)
+        y = np.clip(self.label, 0, 1)
+        z = np.clip(1.0 - np.exp(-hhat), 1e-15, 1 - 1e-15)
+        loss = y * (-np.log(z)) + (1 - y) * hhat
+        return [(self.name[0], self._avg(loss))]
+
+
+def _dcg_at_k(labels: np.ndarray, order: np.ndarray, k: int,
+              label_gain: np.ndarray) -> float:
+    top = order[:k]
+    discounts = 1.0 / np.log2(np.arange(len(top)) + 2.0)
+    return float(np.sum(label_gain[labels[top]] * discounts))
+
+
+class NDCGMetric(Metric):
+    """reference: rank_metric.hpp + dcg_calculator.cpp (NDCG at eval_at)."""
+    is_bigger_better = True
+
+    def __init__(self, config: Config):
+        self.eval_at = list(config.metric.ndcg_eval_at) or [1, 2, 3, 4, 5]
+        self.name = [f"ndcg@{k}" for k in self.eval_at]
+        gains = config.objective_config.label_gain or \
+            [float((1 << i) - 1) for i in range(31)]
+        self.label_gain = np.asarray(gains, np.float64)
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if metadata.query_boundaries is None:
+            log.fatal("NDCG metric requires query information")
+        self.query_boundaries = np.asarray(metadata.query_boundaries)
+        self.query_weights = metadata.query_weights
+
+    def eval(self, score, objective):
+        score = np.asarray(score, np.float64)
+        qb = self.query_boundaries
+        nq = len(qb) - 1
+        results = np.zeros((len(self.eval_at), nq))
+        qw = self.query_weights if self.query_weights is not None else np.ones(nq)
+        for q in range(nq):
+            s, e = qb[q], qb[q + 1]
+            lab = self.label[s:e].astype(int)
+            sc = score[s:e]
+            order = np.argsort(-sc, kind="mergesort")
+            ideal = np.argsort(-lab, kind="mergesort")
+            for ki, k in enumerate(self.eval_at):
+                max_dcg = _dcg_at_k(lab, ideal, k, self.label_gain)
+                if max_dcg <= 0:
+                    results[ki, q] = 1.0  # reference counts empty queries as 1
+                else:
+                    results[ki, q] = _dcg_at_k(lab, order, k, self.label_gain) / max_dcg
+        sum_w = qw.sum()
+        return [(self.name[ki], float(np.sum(results[ki] * qw) / sum_w))
+                for ki in range(len(self.eval_at))]
+
+
+class MAPMetric(Metric):
+    """reference: map_metric.hpp (mean average precision at k)."""
+    is_bigger_better = True
+
+    def __init__(self, config: Config):
+        self.eval_at = list(config.metric.ndcg_eval_at) or [1, 2, 3, 4, 5]
+        self.name = [f"map@{k}" for k in self.eval_at]
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if metadata.query_boundaries is None:
+            log.fatal("MAP metric requires query information")
+        self.query_boundaries = np.asarray(metadata.query_boundaries)
+        self.query_weights = metadata.query_weights
+
+    def eval(self, score, objective):
+        score = np.asarray(score, np.float64)
+        qb = self.query_boundaries
+        nq = len(qb) - 1
+        results = np.zeros((len(self.eval_at), nq))
+        qw = self.query_weights if self.query_weights is not None else np.ones(nq)
+        for q in range(nq):
+            s, e = qb[q], qb[q + 1]
+            rel = (self.label[s:e] > 0).astype(int)
+            order = np.argsort(-score[s:e], kind="mergesort")
+            rel_sorted = rel[order]
+            hits = np.cumsum(rel_sorted)
+            prec = hits / (np.arange(len(rel_sorted)) + 1.0)
+            for ki, k in enumerate(self.eval_at):
+                topk = min(k, len(rel_sorted))
+                num_rel = rel_sorted[:topk].sum()
+                if num_rel > 0:
+                    results[ki, q] = float(np.sum(prec[:topk] * rel_sorted[:topk]) / num_rel)
+        sum_w = qw.sum()
+        return [(self.name[ki], float(np.sum(results[ki] * qw) / sum_w))
+                for ki in range(len(self.eval_at))]
+
+
+_METRIC_REGISTRY = {
+    "l2": L2Metric, "mse": L2Metric, "mean_squared_error": L2Metric,
+    "regression": L2Metric, "regression_l2": L2Metric,
+    "rmse": RMSEMetric, "root_mean_squared_error": RMSEMetric, "l2_root": RMSEMetric,
+    "l1": L1Metric, "mae": L1Metric, "mean_absolute_error": L1Metric,
+    "regression_l1": L1Metric,
+    "huber": HuberMetric,
+    "fair": FairMetric,
+    "poisson": PoissonMetric,
+    "binary_logloss": BinaryLoglossMetric, "binary": BinaryLoglossMetric,
+    "binary_error": BinaryErrorMetric,
+    "auc": AUCMetric,
+    "multi_logloss": MultiLoglossMetric, "multiclass": MultiLoglossMetric,
+    "softmax": MultiLoglossMetric, "multiclassova": MultiLoglossMetric,
+    "multi_error": MultiErrorMetric,
+    "xentropy": CrossEntropyMetric, "cross_entropy": CrossEntropyMetric,
+    "xentlambda": CrossEntropyLambdaMetric,
+    "cross_entropy_lambda": CrossEntropyLambdaMetric,
+    "kldiv": KLDivMetric, "kullback_leibler": KLDivMetric,
+    "ndcg": NDCGMetric, "lambdarank": NDCGMetric,
+    "map": MAPMetric, "mean_average_precision": MAPMetric,
+}
+
+
+def create_metric(name: str, config: Config) -> Optional[Metric]:
+    """Factory (reference: Metric::CreateMetric, metric.cpp:11-46)."""
+    name = name.strip().lower()
+    if name in ("", "none", "null", "na"):
+        return None
+    if name not in _METRIC_REGISTRY:
+        log.fatal("Unknown metric type name: %s" % name)
+    cls = _METRIC_REGISTRY[name]
+    try:
+        return cls(config)
+    except TypeError:
+        return cls()
+
+
+def default_metric_for_objective(objective: str) -> str:
+    """When `metric` is unset the objective implies one (config.cpp)."""
+    mapping = {
+        "regression": "l2", "regression_l2": "l2", "l2": "l2", "mse": "l2",
+        "rmse": "rmse", "l2_root": "rmse",
+        "regression_l1": "l1", "l1": "l1", "mae": "l1",
+        "huber": "huber", "fair": "fair", "poisson": "poisson",
+        "binary": "binary_logloss",
+        "multiclass": "multi_logloss", "softmax": "multi_logloss",
+        "multiclassova": "multi_logloss", "ova": "multi_logloss", "ovr": "multi_logloss",
+        "multiclass_ova": "multi_logloss",
+        "xentropy": "xentropy", "cross_entropy": "xentropy",
+        "xentlambda": "xentlambda", "cross_entropy_lambda": "xentlambda",
+        "lambdarank": "ndcg",
+    }
+    return mapping.get(objective, "l2")

@@ -1,0 +1,1 @@
+from . import histogram, split, predict  # noqa: F401

@@ -1,0 +1,89 @@
+"""Leaf histogram construction — the hottest op in GBDT training.
+
+TPU-native replacement for the reference's gather-accumulate loops
+(`DenseBin::ConstructHistogram`, src/io/dense_bin.hpp:66-133 — the CPU hot
+loop — and the OpenCL `histogram256` kernels,
+src/treelearner/ocl/histogram256.cl:345-790).
+
+Design (SURVEY.md §7): rows carry a `leaf_id`; the histogram of one leaf is
+a masked reduction over ALL rows:
+
+    hist[f, b, c] = sum_r  1[bin[r, f] == b] * w[r, c]
+
+with channels c = (grad*m, hess*m, m) and m the leaf/bagging mask. The
+one-hot compare `bin == iota` turns the scatter-add (which TPUs serialize)
+into a dense contraction that XLA fuses and the MXU executes: per row-chunk
+an einsum `[C,F,B] x [C,3] -> [F,B,3]`. Chunking via `lax.scan` bounds the
+materialized one-hot to VMEM-friendly sizes and gives f32 accumulation
+across chunks (the reference accumulates in f64, bin.h:29-33; chunked f32
+keeps 10M-row sums within tolerance).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _chunk_hist(binned_chunk: jnp.ndarray, w_chunk: jnp.ndarray,
+                num_bins: int, compute_dtype) -> jnp.ndarray:
+    """Histogram of one row chunk: [C,F] x [C,3] -> [F,B,3]."""
+    onehot = (binned_chunk[:, :, None] ==
+              jnp.arange(num_bins, dtype=binned_chunk.dtype)[None, None, :])
+    onehot = onehot.astype(compute_dtype)
+    # HIGHEST keeps the contraction in true f32 on TPU (the default would
+    # drop the MXU inputs to bf16: fine for grad/hess magnitudes, but the
+    # count channel must stay exact for min_data_in_leaf decisions)
+    return jnp.einsum("cfb,cs->fbs", onehot, w_chunk.astype(compute_dtype),
+                      preferred_element_type=jnp.float32,
+                      precision=jax.lax.Precision.HIGHEST)
+
+
+@functools.partial(jax.jit, static_argnames=("num_bins", "chunk"))
+def leaf_histogram(binned: jnp.ndarray, weights: jnp.ndarray,
+                   num_bins: int, chunk: int = 16384) -> jnp.ndarray:
+    """hist[f, b, (g,h,cnt)] over rows where the mask channel is nonzero.
+
+    Args:
+      binned:  [N, F] int bin indices (N must be a multiple of `chunk`;
+               pad rows with mask 0).
+      weights: [N, 3] = (grad*mask, hess*mask, mask). Bagging/GOSS weights
+               fold into the channels (GOSS amplification multiplies grad
+               and hess, the count channel stays 0/1 — goss.hpp:87-131).
+      num_bins: histogram width B (max bins over features).
+    Returns: [F, B, 3] float32.
+    """
+    n, f = binned.shape
+    if n % chunk != 0:
+        raise ValueError(f"rows ({n}) must be padded to a multiple of chunk ({chunk})")
+    n_chunks = n // chunk
+    binned_c = binned.reshape(n_chunks, chunk, f)
+    w_c = weights.reshape(n_chunks, chunk, 3)
+
+    compute_dtype = jnp.float32
+
+    def body(acc, xs):
+        b_chunk, w_chunk = xs
+        return acc + _chunk_hist(b_chunk, w_chunk, num_bins, compute_dtype), None
+
+    init = jnp.zeros((f, num_bins, 3), dtype=jnp.float32)
+    if n_chunks == 1:
+        return init + _chunk_hist(binned_c[0], w_c[0], num_bins, compute_dtype)
+    hist, _ = jax.lax.scan(body, init, (binned_c, w_c))
+    return hist
+
+
+def leaf_weights(grad: jnp.ndarray, hess: jnp.ndarray, leaf_id: jnp.ndarray,
+                 leaf: jnp.ndarray, bag_weight: jnp.ndarray) -> jnp.ndarray:
+    """Build the [N, 3] channel tensor selecting rows of `leaf`."""
+    mask = (leaf_id == leaf)
+    w = jnp.where(mask, bag_weight, 0.0)
+    cnt = jnp.where(mask & (bag_weight > 0), 1.0, 0.0)
+    return jnp.stack([grad * w, hess * w, cnt], axis=-1)
+
+
+def subtract(parent: jnp.ndarray, child: jnp.ndarray) -> jnp.ndarray:
+    """larger-child histogram = parent - smaller-child
+    (reference: FeatureHistogram::Subtract, feature_histogram.hpp:64-70)."""
+    return parent - child

@@ -1,0 +1,2 @@
+from .learners import (DataParallelGrower, FeatureParallelGrower,  # noqa: F401
+                       VotingParallelGrower, make_mesh)

@@ -1,0 +1,148 @@
+"""Distributed tree learners over a jax.sharding.Mesh.
+
+TPU-native replacement for the reference's distributed learner hierarchy
+(`src/treelearner/parallel_tree_learner.h` + data/feature/voting .cpp) and
+the whole socket/MPI collective backend (`src/network/`): the Bruck
+allgather / recursive-halving reduce-scatter schedules (network.cpp:99-163)
+are obsolete — XLA chooses collective schedules over ICI/DCN; what remains
+of the reference design are the three SPMD seams (SURVEY.md §3.5):
+
+  1. leaf sums       -> psum            (was Allreduce of 12-byte tuples)
+  2. histograms      -> psum            (was ReduceScatter + owned-feature
+                                         merge; XLA lowers psum to
+                                         reduce-scatter+all-gather itself)
+  3. best split      -> pmax + masked psum broadcast (was allreduce with a
+                                         custom argmax reducer)
+
+These collectives live INSIDE the jitted tree grower (learner/grow.py) and
+are activated by GrowerConfig.data_axis / feature_axis; this module wraps
+the grower in shard_map with the right partitioning and host-side padding.
+
+Multi-host: the same code runs under jax.distributed initialization — the
+mesh spans hosts, psum rides ICI within a slice and DCN across slices.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .. import log
+from ..learner.grow import GrowerConfig, grow_tree
+
+
+def make_mesh(num_devices: Optional[int] = None, axis_name: str = "data",
+              devices=None) -> Mesh:
+    """1-D mesh over the available devices (reference analogue: the machine
+    list / rank assignment in Network::Init, network.cpp:18-38)."""
+    devs = devices if devices is not None else jax.devices()
+    if num_devices is not None:
+        devs = devs[:num_devices]
+    return Mesh(np.asarray(devs), (axis_name,))
+
+
+def _pad_rows(n: int, multiple: int) -> int:
+    return ((n + multiple - 1) // multiple) * multiple
+
+
+class DataParallelGrower:
+    """Rows sharded over the mesh; histograms psum'd
+    (reference: DataParallelTreeLearner, data_parallel_tree_learner.cpp)."""
+
+    def __init__(self, mesh: Mesh, cfg: GrowerConfig, axis: str = "data"):
+        self.mesh = mesh
+        self.axis = axis
+        self.nshards = mesh.shape[axis]
+        self.cfg = cfg._replace(data_axis=axis)
+
+    def __call__(self, binned, grad, hess, row_weight, feature_mask,
+                 fmeta: Dict):
+        cfg = self.cfg
+        ax = self.axis
+        # out_specs: leaf_id stays sharded by rows; everything else is
+        # replicated (identical on all shards by construction)
+        state_spec = self._state_specs()
+        run = jax.shard_map(
+            lambda b, g, h, w, fm, nb, mt, db, ic:
+                grow_tree(b, g, h, w, fm, nb, mt, db, ic, cfg),
+            mesh=self.mesh,
+            in_specs=(P(ax, None), P(ax), P(ax), P(ax), P(None),
+                      P(None), P(None), P(None), P(None)),
+            out_specs=state_spec,
+            check_vma=False)
+        return run(binned, grad, hess, row_weight, feature_mask,
+                   fmeta["num_bin"], fmeta["missing_type"],
+                   fmeta["default_bin"], fmeta["is_categorical"])
+
+    def _state_specs(self):
+        from ..learner.grow import TreeGrowerState
+        ax = self.axis
+        fields = {name: P() for name in TreeGrowerState._fields}
+        fields["leaf_id"] = P(ax)
+        return TreeGrowerState(**fields)
+
+
+class FeatureParallelGrower:
+    """Features sharded, data replicated; global split via allreduce-argmax
+    (reference: FeatureParallelTreeLearner,
+    feature_parallel_tree_learner.cpp:31-69)."""
+
+    def __init__(self, mesh: Mesh, cfg: GrowerConfig, axis: str = "feature"):
+        self.mesh = mesh
+        self.axis = axis
+        self.nshards = mesh.shape[axis]
+        self.cfg = cfg._replace(feature_axis=axis,
+                                num_feature_shards=self.nshards)
+
+    def pad_features(self, binned: np.ndarray, fmeta: Dict):
+        """Pad the feature dimension to a multiple of the shard count with
+        trivial (1-bin) features that can never split."""
+        f = binned.shape[1]
+        fpad = _pad_rows(f, self.nshards)
+        if fpad == f:
+            return binned, fmeta
+        extra = fpad - f
+        binned = np.concatenate(
+            [binned, np.zeros((binned.shape[0], extra), binned.dtype)], axis=1)
+        fmeta = dict(fmeta)
+        fmeta["num_bin"] = np.concatenate([fmeta["num_bin"], np.ones(extra, np.int32)])
+        fmeta["missing_type"] = np.concatenate([fmeta["missing_type"], np.zeros(extra, np.int32)])
+        fmeta["default_bin"] = np.concatenate([fmeta["default_bin"], np.zeros(extra, np.int32)])
+        fmeta["is_categorical"] = np.concatenate([fmeta["is_categorical"], np.zeros(extra, bool)])
+        return binned, fmeta
+
+    def __call__(self, binned, grad, hess, row_weight, feature_mask, fmeta):
+        cfg = self.cfg
+        ax = self.axis
+        from ..learner.grow import TreeGrowerState
+        fields = {name: P() for name in TreeGrowerState._fields}
+        fields["hist_pool"] = P(None, ax)  # [L, F/shards, B, 3] per shard
+        state_spec = TreeGrowerState(**fields)
+        run = jax.shard_map(
+            lambda b, g, h, w, fm, nb, mt, db, ic:
+                grow_tree(b, g, h, w, fm, nb, mt, db, ic, cfg),
+            mesh=self.mesh,
+            in_specs=(P(None, None), P(None), P(None), P(None), P(None),
+                      P(None), P(None), P(None), P(None)),
+            out_specs=state_spec,
+            check_vma=False)
+        return run(binned, grad, hess, row_weight, feature_mask,
+                   fmeta["num_bin"], fmeta["missing_type"],
+                   fmeta["default_bin"], fmeta["is_categorical"])
+
+
+class VotingParallelGrower(DataParallelGrower):
+    """PV-tree voting-parallel (reference: VotingParallelTreeLearner,
+    voting_parallel_tree_learner.cpp). Round-1 implementation note: the
+    communication-compression (top-k feature voting before the histogram
+    reduce) is expressed by the SAME psum seam — XLA fuses the reduction —
+    so this subclass currently shares the data-parallel path; the explicit
+    top-k gather/scatter optimization lands with the Pallas histogram
+    kernels. Semantics (global split choice) are identical to data-parallel
+    when top_k >= num_features."""
+    pass

@@ -1,0 +1,188 @@
+"""Plotting utilities (matplotlib / graphviz).
+
+Mirrors the reference python-package plotting module
+(`python-package/lightgbm/plotting.py`): plot_importance, plot_metric,
+plot_tree / create_tree_digraph. Matplotlib/graphviz are imported lazily so
+the core package has no hard dependency.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .basic import Booster, LightGBMError
+from .sklearn import LGBMModel
+
+
+def _check_not_tuple_of_2_elements(obj, obj_name="obj"):
+    if not isinstance(obj, tuple) or len(obj) != 2:
+        raise TypeError(f"{obj_name} must be a tuple of 2 elements.")
+
+
+def plot_importance(booster, ax=None, height=0.2, xlim=None, ylim=None,
+                    title="Feature importance", xlabel="Feature importance",
+                    ylabel="Features", importance_type="split",
+                    max_num_features=None, ignore_zero=True, figsize=None,
+                    grid=True, **kwargs):
+    """Reference: plotting.py plot_importance."""
+    try:
+        import matplotlib.pyplot as plt
+    except ImportError:
+        raise ImportError("You must install matplotlib for plot_importance")
+
+    if isinstance(booster, LGBMModel):
+        booster = booster.booster_
+    if not isinstance(booster, Booster):
+        raise TypeError("booster must be Booster or LGBMModel")
+
+    importance = booster.feature_importance(importance_type=importance_type)
+    feature_names = booster.feature_name()
+    tuples = sorted(zip(feature_names, importance), key=lambda x: x[1])
+    if ignore_zero:
+        tuples = [x for x in tuples if x[1] > 0]
+    if max_num_features is not None and max_num_features > 0:
+        tuples = tuples[-max_num_features:]
+    if not tuples:
+        raise ValueError("Booster's feature_importance is empty")
+    labels, values = zip(*tuples)
+
+    if ax is None:
+        _, ax = plt.subplots(1, 1, figsize=figsize)
+    ylocs = np.arange(len(values))
+    ax.barh(ylocs, values, align="center", height=height, **kwargs)
+    for x, y in zip(values, ylocs):
+        ax.text(x + 1, y, str(int(x) if float(x).is_integer() else round(x, 2)),
+                va="center")
+    ax.set_yticks(ylocs)
+    ax.set_yticklabels(labels)
+    if xlim is not None:
+        _check_not_tuple_of_2_elements(xlim, "xlim")
+        ax.set_xlim(xlim)
+    if ylim is not None:
+        _check_not_tuple_of_2_elements(ylim, "ylim")
+        ax.set_ylim(ylim)
+    if title:
+        ax.set_title(title)
+    if xlabel:
+        ax.set_xlabel(xlabel)
+    if ylabel:
+        ax.set_ylabel(ylabel)
+    ax.grid(grid)
+    return ax
+
+
+def plot_metric(booster, metric=None, dataset_names=None, ax=None, xlim=None,
+                ylim=None, title="Metric during training", xlabel="Iterations",
+                ylabel="auto", figsize=None, grid=True):
+    """Reference: plotting.py plot_metric (takes evals_result dict or
+    LGBMModel)."""
+    try:
+        import matplotlib.pyplot as plt
+    except ImportError:
+        raise ImportError("You must install matplotlib for plot_metric")
+
+    if isinstance(booster, LGBMModel):
+        eval_results = dict(booster.evals_result_)
+    elif isinstance(booster, dict):
+        eval_results = dict(booster)
+    else:
+        raise TypeError("booster must be dict or LGBMModel")
+    if not eval_results:
+        raise ValueError("eval results cannot be empty")
+
+    if ax is None:
+        _, ax = plt.subplots(1, 1, figsize=figsize)
+
+    if dataset_names is None:
+        dataset_names = iter(eval_results.keys())
+    name = None
+    for dataset_name in dataset_names:
+        metrics = eval_results.get(dataset_name)
+        if not metrics:
+            continue
+        if metric is None:
+            name, results = list(metrics.items())[0]
+        else:
+            name, results = metric, metrics[metric]
+        ax.plot(range(len(results)), results, label=dataset_name)
+    ax.legend(loc="best")
+    if xlim is not None:
+        ax.set_xlim(xlim)
+    if ylim is not None:
+        ax.set_ylim(ylim)
+    if title:
+        ax.set_title(title)
+    if xlabel:
+        ax.set_xlabel(xlabel)
+    if ylabel == "auto":
+        ylabel = name
+    if ylabel:
+        ax.set_ylabel(ylabel)
+    ax.grid(grid)
+    return ax
+
+
+def create_tree_digraph(booster, tree_index=0, show_info=None, name=None,
+                        comment=None, **kwargs):
+    """Reference: plotting.py create_tree_digraph (graphviz)."""
+    try:
+        from graphviz import Digraph
+    except ImportError:
+        raise ImportError("You must install graphviz for plot_tree")
+
+    if isinstance(booster, LGBMModel):
+        booster = booster.booster_
+    if not isinstance(booster, Booster):
+        raise TypeError("booster must be Booster or LGBMModel")
+    model = booster.dump_model()
+    tree_infos = model["tree_info"]
+    if tree_index >= len(tree_infos):
+        raise IndexError("tree_index is out of range.")
+    tree_info = tree_infos[tree_index]
+    show_info = show_info or []
+
+    graph = Digraph(name=name, comment=comment, **kwargs)
+
+    def add(node, parent=None, decision=None):
+        if "split_index" in node:
+            nid = f"split{node['split_index']}"
+            label = f"split_feature_index: {node['split_feature']}"
+            label += f"\\nthreshold: {node['threshold']:.6g}"
+            for info in show_info:
+                if info in node:
+                    label += f"\\n{info}: {node[info]:.6g}" \
+                        if isinstance(node[info], float) else f"\\n{info}: {node[info]}"
+            graph.node(nid, label=label)
+            add(node["left_child"], nid, node.get("decision_type", "<="))
+            add(node["right_child"], nid, ">")
+        else:
+            nid = f"leaf{node['leaf_index']}"
+            label = f"leaf_index: {node['leaf_index']}"
+            label += f"\\nleaf_value: {node['leaf_value']:.6g}"
+            if "leaf_count" in show_info and "leaf_count" in node:
+                label += f"\\nleaf_count: {node['leaf_count']}"
+            graph.node(nid, label=label)
+        if parent is not None:
+            graph.edge(parent, nid, decision)
+
+    add(tree_info["tree_structure"])
+    return graph
+
+
+def plot_tree(booster, ax=None, tree_index=0, figsize=None, show_info=None,
+              **kwargs):
+    """Reference: plotting.py plot_tree (renders the digraph into an axes)."""
+    try:
+        import matplotlib.image as image
+        import matplotlib.pyplot as plt
+    except ImportError:
+        raise ImportError("You must install matplotlib for plot_tree")
+    if ax is None:
+        _, ax = plt.subplots(1, 1, figsize=figsize)
+    graph = create_tree_digraph(booster=booster, tree_index=tree_index,
+                                show_info=show_info, **kwargs)
+    import io
+    s = io.BytesIO(graph.pipe(format="png"))
+    img = image.imread(s)
+    ax.imshow(img)
+    ax.axis("off")
+    return ax

@@ -1,0 +1,303 @@
+"""scikit-learn API wrappers.
+
+Mirrors the reference sklearn interface
+(`python-package/lightgbm/sklearn.py:584-759`): LGBMModel base +
+LGBMRegressor / LGBMClassifier / LGBMRanker, supporting get_params/
+set_params/clone, fit with eval sets and early stopping, custom objective
+callables, and joblib persistence via Booster string round-trip.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from . import log
+from .basic import Booster, Dataset, LightGBMError
+from .engine import train
+
+
+def _objective_decorator(func: Callable) -> Callable:
+    """Wrap sklearn-style fobj(y_true, y_pred) -> (grad, hess)
+    (reference: sklearn.py:23-76)."""
+    def inner(preds, dataset):
+        labels = dataset.get_label()
+        argc = func.__code__.co_argcount
+        if argc == 2:
+            grad, hess = func(labels, preds)
+        elif argc == 3:
+            grad, hess = func(labels, preds, dataset.get_group())
+        else:
+            raise TypeError(f"Self-defined objective should have 2 or 3 arguments, got {argc}")
+        return grad, hess
+    return inner
+
+
+def _eval_decorator(func: Callable) -> Callable:
+    def inner(preds, dataset):
+        labels = dataset.get_label()
+        argc = func.__code__.co_argcount
+        if argc == 2:
+            return func(labels, preds)
+        if argc == 3:
+            return func(labels, preds, dataset.get_weight())
+        if argc == 4:
+            return func(labels, preds, dataset.get_weight(), dataset.get_group())
+        raise TypeError(f"Self-defined eval function should have 2-4 arguments, got {argc}")
+    return inner
+
+
+try:
+    from sklearn.base import (BaseEstimator as _SKBase,
+                              ClassifierMixin as _SKClassifierMixin,
+                              RegressorMixin as _SKRegressorMixin)
+except ImportError:  # sklearn optional
+    class _SKBase:
+        pass
+
+    class _SKClassifierMixin:
+        pass
+
+    class _SKRegressorMixin:
+        pass
+
+
+class LGBMModel(_SKBase):
+    """Reference: sklearn.py:96-583 (LGBMModel)."""
+
+    def __init__(self, boosting_type: str = "gbdt", num_leaves: int = 31,
+                 max_depth: int = -1, learning_rate: float = 0.1,
+                 n_estimators: int = 100, max_bin: int = 255,
+                 subsample_for_bin: int = 200000, objective: Optional[str] = None,
+                 min_split_gain: float = 0.0, min_child_weight: float = 1e-3,
+                 min_child_samples: int = 20, subsample: float = 1.0,
+                 subsample_freq: int = 0, colsample_bytree: float = 1.0,
+                 reg_alpha: float = 0.0, reg_lambda: float = 0.0,
+                 random_state: Optional[int] = None, n_jobs: int = -1,
+                 silent: bool = True, **kwargs):
+        self.boosting_type = boosting_type
+        self.num_leaves = num_leaves
+        self.max_depth = max_depth
+        self.learning_rate = learning_rate
+        self.n_estimators = n_estimators
+        self.max_bin = max_bin
+        self.subsample_for_bin = subsample_for_bin
+        self.objective = objective
+        self.min_split_gain = min_split_gain
+        self.min_child_weight = min_child_weight
+        self.min_child_samples = min_child_samples
+        self.subsample = subsample
+        self.subsample_freq = subsample_freq
+        self.colsample_bytree = colsample_bytree
+        self.reg_alpha = reg_alpha
+        self.reg_lambda = reg_lambda
+        self.random_state = random_state
+        self.n_jobs = n_jobs
+        self.silent = silent
+        self._other_params: Dict = dict(kwargs)
+        self._Booster: Optional[Booster] = None
+        self._evals_result: Dict = {}
+        self._best_iteration = -1
+        self._n_features = 0
+        self._classes = None
+        self._n_classes = 1
+
+    # -- sklearn protocol -------------------------------------------------
+    def get_params(self, deep: bool = True) -> Dict:
+        params = {
+            "boosting_type": self.boosting_type, "num_leaves": self.num_leaves,
+            "max_depth": self.max_depth, "learning_rate": self.learning_rate,
+            "n_estimators": self.n_estimators, "max_bin": self.max_bin,
+            "subsample_for_bin": self.subsample_for_bin, "objective": self.objective,
+            "min_split_gain": self.min_split_gain,
+            "min_child_weight": self.min_child_weight,
+            "min_child_samples": self.min_child_samples,
+            "subsample": self.subsample, "subsample_freq": self.subsample_freq,
+            "colsample_bytree": self.colsample_bytree,
+            "reg_alpha": self.reg_alpha, "reg_lambda": self.reg_lambda,
+            "random_state": self.random_state, "n_jobs": self.n_jobs,
+            "silent": self.silent,
+        }
+        params.update(self._other_params)
+        return params
+
+    def set_params(self, **params) -> "LGBMModel":
+        for key, value in params.items():
+            if hasattr(self, key) and not key.startswith("_"):
+                setattr(self, key, value)
+            else:
+                self._other_params[key] = value
+        return self
+
+    # ---------------------------------------------------------------------
+    def _default_objective(self) -> str:
+        return "regression"
+
+    def _train_params(self) -> Dict:
+        params = {
+            "boosting_type": self.boosting_type,
+            "num_leaves": self.num_leaves,
+            "max_depth": self.max_depth,
+            "learning_rate": self.learning_rate,
+            "max_bin": self.max_bin,
+            "bin_construct_sample_cnt": self.subsample_for_bin,
+            "min_gain_to_split": self.min_split_gain,
+            "min_sum_hessian_in_leaf": self.min_child_weight,
+            "min_data_in_leaf": self.min_child_samples,
+            "bagging_fraction": self.subsample,
+            "bagging_freq": self.subsample_freq,
+            "feature_fraction": self.colsample_bytree,
+            "lambda_l1": self.reg_alpha,
+            "lambda_l2": self.reg_lambda,
+            "verbose": -1 if self.silent else 1,
+        }
+        if self.random_state is not None:
+            params["seed"] = self.random_state
+        obj = self.objective if isinstance(self.objective, str) and self.objective \
+            else self._default_objective()
+        params["objective"] = obj
+        params.update(self._other_params)
+        return params
+
+    def fit(self, X, y, sample_weight=None, init_score=None, group=None,
+            eval_set=None, eval_names=None, eval_sample_weight=None,
+            eval_init_score=None, eval_group=None, eval_metric=None,
+            early_stopping_rounds=None, verbose: bool = False,
+            feature_name: str = "auto", categorical_feature: str = "auto",
+            callbacks=None) -> "LGBMModel":
+        params = self._train_params()
+        fobj = None
+        if callable(self.objective):
+            fobj = _objective_decorator(self.objective)
+            params["objective"] = "none"
+        if eval_metric is not None and isinstance(eval_metric, str):
+            params["metric"] = eval_metric
+        feval = _eval_decorator(eval_metric) if callable(eval_metric) else None
+
+        X = np.asarray(X, np.float64) if not hasattr(X, "dtypes") else X
+        train_set = Dataset(X, label=y, weight=sample_weight, group=group,
+                            init_score=init_score, params=params,
+                            feature_name=feature_name,
+                            categorical_feature=categorical_feature)
+        valid_sets = []
+        valid_names = []
+        if eval_set is not None:
+            if isinstance(eval_set, tuple):
+                eval_set = [eval_set]
+            for i, (vx, vy) in enumerate(eval_set):
+                vw = eval_sample_weight[i] if eval_sample_weight else None
+                vg = eval_group[i] if eval_group else None
+                vi = eval_init_score[i] if eval_init_score else None
+                if np.asarray(vx).shape == np.asarray(X).shape and \
+                        np.array_equal(np.asarray(vx, np.float64), np.asarray(X, np.float64)):
+                    valid_sets.append(train_set)
+                else:
+                    valid_sets.append(train_set.create_valid(
+                        vx, label=vy, weight=vw, group=vg, init_score=vi))
+                valid_names.append(eval_names[i] if eval_names else f"valid_{i}")
+
+        self._evals_result = {}
+        self._Booster = train(
+            params, train_set, num_boost_round=self.n_estimators,
+            valid_sets=valid_sets, valid_names=valid_names,
+            fobj=fobj, feval=feval,
+            early_stopping_rounds=early_stopping_rounds,
+            evals_result=self._evals_result, verbose_eval=verbose,
+            callbacks=callbacks)
+        self._best_iteration = self._Booster.best_iteration
+        self._n_features = self._Booster.num_feature()
+        return self
+
+    def predict(self, X, raw_score: bool = False, num_iteration: int = -1,
+                pred_leaf: bool = False, pred_contrib: bool = False):
+        if self._Booster is None:
+            raise LightGBMError("Estimator not fitted, call fit before predict")
+        return self._Booster.predict(X, num_iteration=num_iteration,
+                                     raw_score=raw_score, pred_leaf=pred_leaf,
+                                     pred_contrib=pred_contrib)
+
+    @property
+    def booster_(self) -> Booster:
+        if self._Booster is None:
+            raise LightGBMError("No booster found; call fit first")
+        return self._Booster
+
+    @property
+    def evals_result_(self) -> Dict:
+        return self._evals_result
+
+    @property
+    def best_iteration_(self) -> int:
+        return self._best_iteration
+
+    @property
+    def feature_importances_(self) -> np.ndarray:
+        return self.booster_.feature_importance()
+
+    @property
+    def n_features_(self) -> int:
+        return self._n_features
+
+    # joblib / pickle use default __getstate__ (Booster pickles via string)
+
+
+class LGBMRegressor(_SKRegressorMixin, LGBMModel):
+    def _default_objective(self) -> str:
+        return "regression"
+
+
+class LGBMClassifier(_SKClassifierMixin, LGBMModel):
+    def _default_objective(self) -> str:
+        return "multiclass" if self._n_classes > 2 else "binary"
+
+    def fit(self, X, y, **kwargs):
+        y = np.asarray(y).ravel()
+        self._classes, y_enc = np.unique(y, return_inverse=True)
+        self._n_classes = len(self._classes)
+        if self._n_classes > 2 and not (isinstance(self.objective, str) and self.objective):
+            self._other_params.setdefault("num_class", self._n_classes)
+        super().fit(X, y_enc, **kwargs)
+        return self
+
+    @property
+    def classes_(self):
+        return self._classes
+
+    @property
+    def n_classes_(self) -> int:
+        return self._n_classes
+
+    def predict(self, X, raw_score: bool = False, num_iteration: int = -1,
+                pred_leaf: bool = False, pred_contrib: bool = False):
+        result = self.predict_proba(X, raw_score, num_iteration,
+                                    pred_leaf, pred_contrib)
+        if raw_score or pred_leaf or pred_contrib:
+            return result
+        if result.ndim == 1:  # binary probabilities
+            idx = (result > 0.5).astype(int)
+        else:
+            idx = np.argmax(result, axis=1)
+        return self._classes[idx]
+
+    def predict_proba(self, X, raw_score: bool = False, num_iteration: int = -1,
+                      pred_leaf: bool = False, pred_contrib: bool = False):
+        result = super().predict(X, raw_score, num_iteration, pred_leaf, pred_contrib)
+        if raw_score or pred_leaf or pred_contrib:
+            return result
+        if self._n_classes <= 2 and result.ndim == 1:
+            return np.vstack([1.0 - result, result]).T
+        return result
+
+    def predict_proba_raw(self, X):
+        return super().predict(X, raw_score=True)
+
+
+class LGBMRanker(LGBMModel):
+    def _default_objective(self) -> str:
+        return "lambdarank"
+
+    def fit(self, X, y, group=None, **kwargs):
+        if group is None:
+            raise ValueError("Should set group for ranking task")
+        super().fit(X, y, group=group, **kwargs)
+        return self

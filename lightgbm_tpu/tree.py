@@ -1,0 +1,307 @@
+"""Host-side tree model: fixed-capacity struct-of-arrays + text round-trip.
+
+Mirrors the reference `Tree` (include/LightGBM/tree.h:20-450,
+src/io/tree.cpp): leaf-wise tree stored as parallel arrays over internal
+nodes (children encode leaves as `~leaf`), with LightGBM's `Tree=` text
+block format (tree.cpp:208-260) for model save/load — models written here
+are loadable by the reference and vice versa for the feature subset both
+support (numerical + one-vs-rest categorical splits).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from . import log
+from .binning import MISSING_NAN, MISSING_NONE, MISSING_ZERO
+
+# decision_type bit layout (reference: tree.h:268-284)
+_CAT_MASK = 1
+_DEFAULT_LEFT_MASK = 2
+
+
+def _avoid_inf(x: float) -> float:
+    """Reference: Common::AvoidInf (clamps +-inf thresholds for text IO)."""
+    if np.isnan(x):
+        return 0.0
+    if x >= 1e300:
+        return 1e300
+    if x <= -1e300:
+        return -1e300
+    return float(x)
+
+
+class Tree:
+    """One decision tree (host representation)."""
+
+    def __init__(self, num_leaves: int = 1):
+        self.num_leaves = num_leaves
+        # False for models loaded from reference-LightGBM text (no tpu_*
+        # lines); binned-matrix traversal requires attach_bin_metadata first
+        self.has_bin_metadata = True
+        m = max(num_leaves - 1, 1)
+        self.split_feature_inner = np.zeros(m, np.int32)   # used-feature space
+        self.split_feature = np.zeros(m, np.int32)         # original columns
+        self.threshold_in_bin = np.zeros(m, np.int32)
+        self.threshold = np.zeros(m, np.float64)
+        self.decision_type = np.zeros(m, np.int32)
+        self.split_gain = np.zeros(m, np.float64)
+        self.left_child = np.full(m, -1, np.int32)
+        self.right_child = np.full(m, -1, np.int32)
+        self.leaf_value = np.zeros(num_leaves, np.float64)
+        self.leaf_count = np.zeros(num_leaves, np.int64)
+        self.internal_value = np.zeros(m, np.float64)
+        self.internal_count = np.zeros(m, np.int64)
+        self.shrinkage = 1.0
+        # device-traversal metadata (not serialized; rebuilt on load)
+        self.node_missing = np.zeros(m, np.int32)
+        self.node_nan_bin = np.zeros(m, np.int32)
+        self.node_default_bin = np.zeros(m, np.int32)
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_grower_state(cls, state, dataset) -> "Tree":
+        """Convert a TreeGrowerState (learner/grow.py) into a host Tree,
+        resolving bin thresholds to raw-space values via the BinMappers
+        (reference: SerialTreeLearner::Split computes threshold_double via
+        BinToValue, serial_tree_learner.cpp:519-560)."""
+        nl = int(state.num_leaves_used)
+        t = cls(nl)
+        m = nl - 1
+        if m <= 0:
+            t.leaf_value[0] = float(np.asarray(state.leaf_value)[0])
+            cnt = np.asarray(state.count)
+            t.leaf_count[0] = int(cnt[0])
+            return t
+        feat = np.asarray(state.node_feature)[:m]
+        thr = np.asarray(state.node_threshold)[:m]
+        dl = np.asarray(state.node_default_left)[:m]
+        cat = np.asarray(state.node_is_cat)[:m]
+        t.split_feature_inner = feat.astype(np.int32)
+        t.split_feature = np.asarray(
+            [dataset.real_feature_index(int(j)) for j in feat], np.int32)
+        t.threshold_in_bin = thr.astype(np.int32)
+        t.split_gain = np.asarray(state.node_gain)[:m].astype(np.float64)
+        t.left_child = np.asarray(state.node_left)[:m].astype(np.int32)
+        t.right_child = np.asarray(state.node_right)[:m].astype(np.int32)
+        t.internal_value = np.asarray(state.node_value)[:m].astype(np.float64)
+        t.internal_count = np.asarray(state.node_count)[:m].astype(np.int64)
+        t.leaf_value = np.asarray(state.leaf_value)[:nl].astype(np.float64)
+        t.leaf_count = np.asarray(state.count)[:nl].astype(np.int64)
+        for i in range(m):
+            mapper = dataset.feature_mapper(int(feat[i]))
+            t.node_missing[i] = mapper.missing_type
+            t.node_nan_bin[i] = mapper.num_bin - 1
+            t.node_default_bin[i] = mapper.default_bin
+            dt = 0
+            if cat[i]:
+                dt |= _CAT_MASK
+                t.threshold[i] = float(mapper.bin_to_value(int(thr[i])))
+            else:
+                if dl[i]:
+                    dt |= _DEFAULT_LEFT_MASK
+                t.threshold[i] = _avoid_inf(mapper.bin_to_value(int(thr[i])))
+            # missing type bits 2-3 (tree.h:268-284)
+            dt |= {MISSING_NONE: 0, MISSING_ZERO: 1 << 2, MISSING_NAN: 2 << 2}[
+                mapper.missing_type]
+            t.decision_type[i] = dt
+        return t
+
+    # ------------------------------------------------------------------
+    def attach_bin_metadata(self, dataset) -> None:
+        """Rebuild bin-space traversal metadata from a Dataset's BinMappers
+        for trees loaded from reference-format model text (raw thresholds
+        only). The bin threshold is the last bin whose upper bound is <=
+        the real threshold, matching `left = value <= threshold_real`."""
+        inner_of = {real: inner for inner, real
+                    in enumerate(dataset.used_features)}
+        m = self.num_leaves - 1
+        for i in range(m):
+            real = int(self.split_feature[i])
+            if real not in inner_of:
+                log.fatal("Loaded model splits on feature %d which is "
+                          "trivial/absent in the dataset" % real)
+            inner = inner_of[real]
+            mapper = dataset.feature_mapper(inner)
+            self.split_feature_inner[i] = inner
+            self.node_missing[i] = mapper.missing_type
+            self.node_nan_bin[i] = mapper.num_bin - 1
+            self.node_default_bin[i] = mapper.default_bin
+            if self.is_categorical_node(i):
+                self.threshold_in_bin[i] = mapper.categorical_2_bin.get(
+                    int(self.threshold[i]), mapper.num_bin - 1)
+            else:
+                self.threshold_in_bin[i] = mapper.value_to_bin(
+                    float(self.threshold[i]))
+        self.has_bin_metadata = True
+
+    # ------------------------------------------------------------------
+    def is_categorical_node(self, i: int) -> bool:
+        return bool(self.decision_type[i] & _CAT_MASK)
+
+    def default_left_node(self, i: int) -> bool:
+        return bool(self.decision_type[i] & _DEFAULT_LEFT_MASK)
+
+    def missing_type_node(self, i: int) -> int:
+        return int(self.decision_type[i] >> 2) & 3
+
+    def apply_shrinkage(self, rate: float) -> None:
+        """Reference: Tree::Shrinkage (tree.h:166-173)."""
+        self.leaf_value *= rate
+        self.internal_value *= rate
+        self.shrinkage *= rate
+
+    def add_bias(self, val: float) -> None:
+        """Reference: Tree::AddBias (boost_from_average path)."""
+        self.leaf_value += val
+        self.internal_value += val
+
+    # ------------------------------------------------------------------
+    def to_device(self):
+        """Build the DeviceTree used by ops/predict.py."""
+        import jax.numpy as jnp
+        from .ops.predict import DeviceTree
+        m = max(self.num_leaves - 1, 1)
+        dl = np.asarray([self.default_left_node(i) for i in range(m)], bool)
+        cat = np.asarray([self.is_categorical_node(i) for i in range(m)], bool)
+        miss = np.asarray([self.missing_type_node(i) for i in range(m)], np.int32)
+        return DeviceTree(
+            num_leaves=jnp.int32(self.num_leaves),
+            split_feature=jnp.asarray(self.split_feature_inner),
+            threshold_bin=jnp.asarray(self.threshold_in_bin),
+            threshold_real=jnp.asarray(self.threshold, jnp.float32),
+            default_left=jnp.asarray(dl),
+            is_categorical=jnp.asarray(cat),
+            left_child=jnp.asarray(self.left_child),
+            right_child=jnp.asarray(self.right_child),
+            node_missing=jnp.asarray(miss),
+            node_nan_bin=jnp.asarray(self.node_nan_bin),
+            node_default_bin=jnp.asarray(self.node_default_bin),
+            leaf_value=jnp.asarray(self.leaf_value, jnp.float32),
+            split_gain=jnp.asarray(self.split_gain, jnp.float32),
+            internal_value=jnp.asarray(self.internal_value, jnp.float32),
+            internal_count=jnp.asarray(self.internal_count, jnp.float32),
+            leaf_count=jnp.asarray(self.leaf_count, jnp.float32),
+        )
+
+    def to_device_raw(self):
+        """DeviceTree for raw-feature traversal (split_feature = original
+        column indices, decisions on real thresholds)."""
+        dt = self.to_device()
+        import jax.numpy as jnp
+        return dt._replace(split_feature=jnp.asarray(self.split_feature))
+
+    # ------------------------------------------------------------------
+    def predict_row(self, row: np.ndarray) -> float:
+        """Scalar reference traversal (tree.h:416-450) for testing/host paths."""
+        if self.num_leaves <= 1:
+            return float(self.leaf_value[0])
+        node = 0
+        while node >= 0:
+            fval = row[self.split_feature[node]]
+            if self.is_categorical_node(node):
+                go_left = (not np.isnan(fval)) and int(fval) == int(self.threshold[node])
+            else:
+                mt = self.missing_type_node(node)
+                is_missing = (mt == MISSING_NAN and np.isnan(fval)) or \
+                             (mt == MISSING_ZERO and (np.isnan(fval) or abs(fval) <= 1e-35))
+                if is_missing:
+                    go_left = self.default_left_node(node)
+                else:
+                    go_left = fval <= self.threshold[node]
+            node = self.left_child[node] if go_left else self.right_child[node]
+        return float(self.leaf_value[~node])
+
+    # ------------------------------------------------------------------
+    # text model format (reference: Tree::ToString, tree.cpp:208-260)
+    def to_string(self) -> str:
+        m = self.num_leaves - 1
+        out = []
+        out.append(f"num_leaves={self.num_leaves}")
+        out.append(f"num_cat=0")
+        out.append("split_feature=" + " ".join(str(int(x)) for x in self.split_feature[:m]))
+        out.append("split_gain=" + " ".join(repr(float(x)) for x in self.split_gain[:m]))
+        out.append("threshold=" + " ".join(repr(float(x)) for x in self.threshold[:m]))
+        out.append("decision_type=" + " ".join(str(int(x)) for x in self.decision_type[:m]))
+        out.append("left_child=" + " ".join(str(int(x)) for x in self.left_child[:m]))
+        out.append("right_child=" + " ".join(str(int(x)) for x in self.right_child[:m]))
+        out.append("leaf_value=" + " ".join(repr(float(x)) for x in self.leaf_value[:self.num_leaves]))
+        out.append("leaf_count=" + " ".join(str(int(x)) for x in self.leaf_count[:self.num_leaves]))
+        out.append("internal_value=" + " ".join(repr(float(x)) for x in self.internal_value[:m]))
+        out.append("internal_count=" + " ".join(str(int(x)) for x in self.internal_count[:m]))
+        out.append(f"shrinkage={self.shrinkage}")
+        # extension over the reference format: bin-space metadata so loaded
+        # models can still traverse binned matrices on device
+        out.append("tpu_threshold_in_bin=" + " ".join(str(int(x)) for x in self.threshold_in_bin[:m]))
+        out.append("tpu_split_feature_inner=" + " ".join(str(int(x)) for x in self.split_feature_inner[:m]))
+        out.append("tpu_nan_bin=" + " ".join(str(int(x)) for x in self.node_nan_bin[:m]))
+        out.append("tpu_default_bin=" + " ".join(str(int(x)) for x in self.node_default_bin[:m]))
+        return "\n".join(out) + "\n"
+
+    @classmethod
+    def from_string(cls, text: str) -> "Tree":
+        kv = {}
+        for line in text.strip().splitlines():
+            if "=" in line:
+                k, v = line.split("=", 1)
+                kv[k.strip()] = v.strip()
+        nl = int(kv["num_leaves"])
+        t = cls(nl)
+        m = nl - 1
+
+        def arr(key, dtype, size, default=0):
+            if key not in kv or not kv[key]:
+                return np.full(size, default, dtype)
+            vals = kv[key].split()
+            return np.asarray([dtype(v) for v in vals], dtype)
+
+        if m > 0:
+            t.split_feature = arr("split_feature", np.int32, m)
+            t.split_gain = arr("split_gain", np.float64, m)
+            t.threshold = arr("threshold", np.float64, m)
+            t.decision_type = arr("decision_type", np.int32, m)
+            t.left_child = arr("left_child", np.int32, m)
+            t.right_child = arr("right_child", np.int32, m)
+            t.internal_value = arr("internal_value", np.float64, m)
+            t.internal_count = arr("internal_count", np.int64, m)
+            t.has_bin_metadata = "tpu_threshold_in_bin" in kv
+            t.threshold_in_bin = arr("tpu_threshold_in_bin", np.int32, m)
+            t.split_feature_inner = arr("tpu_split_feature_inner", np.int32, m,
+                                        default=-1)
+            if (t.split_feature_inner < 0).all():
+                t.split_feature_inner = t.split_feature.copy()
+            t.node_nan_bin = arr("tpu_nan_bin", np.int32, m)
+            t.node_default_bin = arr("tpu_default_bin", np.int32, m)
+            t.node_missing = np.asarray(
+                [t.missing_type_node(i) for i in range(m)], np.int32)
+        t.leaf_value = arr("leaf_value", np.float64, nl)
+        t.leaf_count = arr("leaf_count", np.int64, nl)
+        t.shrinkage = float(kv.get("shrinkage", 1.0))
+        return t
+
+    # ------------------------------------------------------------------
+    def to_json(self) -> dict:
+        """Reference: Tree::ToJSON (tree.cpp:262-330)."""
+        def node_json(idx: int) -> dict:
+            if idx < 0:
+                leaf = ~idx
+                return {"leaf_index": int(leaf),
+                        "leaf_value": float(self.leaf_value[leaf]),
+                        "leaf_count": int(self.leaf_count[leaf])}
+            return {
+                "split_index": int(idx),
+                "split_feature": int(self.split_feature[idx]),
+                "split_gain": float(self.split_gain[idx]),
+                "threshold": float(self.threshold[idx]),
+                "decision_type": "==" if self.is_categorical_node(idx) else "<=",
+                "default_left": self.default_left_node(idx),
+                "missing_type": ["None", "Zero", "NaN"][self.missing_type_node(idx)],
+                "internal_value": float(self.internal_value[idx]),
+                "internal_count": int(self.internal_count[idx]),
+                "left_child": node_json(int(self.left_child[idx])),
+                "right_child": node_json(int(self.right_child[idx])),
+            }
+        return {"num_leaves": int(self.num_leaves), "shrinkage": self.shrinkage,
+                "tree_structure": node_json(0) if self.num_leaves > 1 else
+                {"leaf_value": float(self.leaf_value[0])}}

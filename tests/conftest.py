@@ -1,0 +1,22 @@
+"""Test configuration: force CPU with 8 virtual devices so distributed
+(mesh) paths are exercised without TPU hardware, as SURVEY.md §4 prescribes
+(the in-process N-rank fake backend the reference never built).
+
+Note: the environment may pre-register an accelerator plugin at interpreter
+startup and pin `jax_platforms` via jax.config (sitecustomize), so setting
+the JAX_PLATFORMS env var here is not enough — we must override the config
+value itself before any backend is initialized.
+"""
+import os
+
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.devices()[0].platform == "cpu", "tests must run on the CPU backend"
+assert len(jax.devices()) == 8, "tests expect 8 virtual CPU devices"

@@ -1,0 +1,124 @@
+"""BinMapper semantics tests (reference behaviors from src/io/bin.cpp)."""
+import numpy as np
+import pytest
+
+from lightgbm_tpu.binning import (BIN_CATEGORICAL, MISSING_NAN, MISSING_NONE,
+                                  MISSING_ZERO, BinMapper, find_bin_mappers)
+
+
+def test_distinct_values_each_get_bin():
+    m = BinMapper()
+    vals = np.array([1.0, 2.0, 3.0, 1.0, 2.0, 3.0] * 10)
+    m.find_bin(vals, len(vals), max_bin=255, min_data_in_bin=1)
+    # 3 distinct nonzero values + implied absence of zero
+    assert m.num_bin >= 3
+    assert m.value_to_bin(1.0) != m.value_to_bin(2.0)
+    assert m.value_to_bin(2.0) != m.value_to_bin(3.0)
+    # threshold midpoints: 1.5 separates 1 and 2
+    assert m.value_to_bin(1.4) == m.value_to_bin(1.0)
+    assert m.value_to_bin(1.6) == m.value_to_bin(2.0)
+
+
+def test_zero_gets_own_bin():
+    m = BinMapper()
+    vals = np.array([-2.0, -1.0, 1.0, 2.0] * 25)
+    # 60 zeros implied: total = 160
+    m.find_bin(vals, 160, max_bin=63, min_data_in_bin=1)
+    zb = m.value_to_bin(0.0)
+    assert m.value_to_bin(-1.0) != zb
+    assert m.value_to_bin(1.0) != zb
+    assert m.default_bin == zb
+
+
+def test_nan_goes_to_last_bin():
+    m = BinMapper()
+    vals = np.array([1.0, 2.0, 3.0, np.nan, np.nan] * 20)
+    m.find_bin(vals, 100, max_bin=63, min_data_in_bin=1, use_missing=True)
+    assert m.missing_type == MISSING_NAN
+    assert m.value_to_bin(np.nan) == m.num_bin - 1
+    assert m.value_to_bin(2.0) < m.num_bin - 1
+
+
+def test_no_missing_when_use_missing_false():
+    m = BinMapper()
+    vals = np.array([1.0, 2.0, np.nan] * 20)
+    m.find_bin(vals, 60, max_bin=63, min_data_in_bin=1, use_missing=False)
+    assert m.missing_type == MISSING_NONE
+
+
+def test_zero_as_missing():
+    m = BinMapper()
+    vals = np.array([1.0, 2.0, 3.0, 4.0] * 20)
+    m.find_bin(vals, 120, max_bin=63, min_data_in_bin=1, zero_as_missing=True)
+    assert m.missing_type == MISSING_ZERO
+
+
+def test_max_bin_respected():
+    m = BinMapper()
+    rng = np.random.RandomState(0)
+    vals = rng.randn(10000)
+    m.find_bin(vals, 10000, max_bin=16, min_data_in_bin=1)
+    assert m.num_bin <= 16
+    bins = m.values_to_bins(vals)
+    assert bins.max() < m.num_bin
+
+
+def test_equal_count_binning_roughly_balanced():
+    m = BinMapper()
+    rng = np.random.RandomState(1)
+    vals = rng.rand(20000) + 1.0  # no zeros
+    m.find_bin(vals, 20000, max_bin=32, min_data_in_bin=1)
+    bins = m.values_to_bins(vals)
+    counts = np.bincount(bins, minlength=m.num_bin)
+    nz = counts[counts > 0]
+    # greedy equal-count: no bin should be more than ~4x the mean
+    assert nz.max() < 4 * nz.mean()
+
+
+def test_categorical_mapping():
+    m = BinMapper()
+    vals = np.array([3.0] * 50 + [7.0] * 30 + [1.0] * 20)
+    m.find_bin(vals, 100, max_bin=63, min_data_in_bin=1,
+               bin_type=BIN_CATEGORICAL)
+    # most frequent category gets bin 0
+    assert m.value_to_bin(3.0) == 0
+    assert m.value_to_bin(7.0) == 1
+    assert m.value_to_bin(1.0) == 2
+    assert m.bin_to_value(0) == 3.0
+
+
+def test_trivial_feature():
+    m = BinMapper()
+    vals = np.full(100, 5.0)
+    m.find_bin(vals, 100, max_bin=63, min_data_in_bin=1)
+    assert m.is_trivial
+
+
+def test_values_to_bins_matches_scalar():
+    rng = np.random.RandomState(2)
+    vals = np.concatenate([rng.randn(500), [np.nan] * 20, [0.0] * 30])
+    m = BinMapper()
+    m.find_bin(vals[(vals != 0) | np.isnan(vals)], len(vals), max_bin=63,
+               min_data_in_bin=1)
+    vec = m.values_to_bins(vals)
+    for i in range(0, len(vals), 7):
+        assert vec[i] == m.value_to_bin(vals[i])
+
+
+def test_find_bin_mappers_drops_trivial():
+    rng = np.random.RandomState(3)
+    X = rng.randn(200, 4)
+    X[:, 2] = 1.0  # constant
+    mappers = find_bin_mappers(X, max_bin=63)
+    assert not mappers[0].is_trivial
+    assert mappers[2].is_trivial
+
+
+def test_serialization_roundtrip():
+    rng = np.random.RandomState(4)
+    vals = rng.randn(1000)
+    m = BinMapper()
+    m.find_bin(vals, 1000, max_bin=63, min_data_in_bin=1)
+    m2 = BinMapper.from_dict(m.to_dict())
+    x = rng.randn(100)
+    assert np.array_equal(m.values_to_bins(x), m2.values_to_bins(x))

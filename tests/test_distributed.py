@@ -1,0 +1,112 @@
+"""Distributed learner tests on the 8-virtual-device CPU mesh — the
+in-process N-rank harness the reference lacks (SURVEY.md §4 item 4:
+'Distributed testing: none automated' — we fix that)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from lightgbm_tpu.dataset import Dataset
+from lightgbm_tpu.learner.grow import GrowerConfig, grow_tree
+from lightgbm_tpu.parallel import (DataParallelGrower, FeatureParallelGrower,
+                                   VotingParallelGrower, make_mesh)
+
+
+@pytest.fixture(scope="module")
+def problem():
+    rng = np.random.RandomState(0)
+    n, f = 2048, 8
+    X = rng.randn(n, f)
+    y = (X[:, 0] + 0.5 * X[:, 1] ** 2 + 0.1 * rng.randn(n)).astype(np.float32)
+    ds = Dataset.from_numpy(X, y, max_bin=63, min_data_in_bin=1)
+    grad = -y
+    hess = np.ones(n, np.float32)
+    return ds, grad, hess
+
+
+def _cfg(ds, chunk=256, **kw):
+    base = dict(num_leaves=31, max_bins=int(ds.max_num_bin()), chunk=chunk,
+                lambda_l1=0.0, lambda_l2=0.0, min_gain_to_split=0.0,
+                min_data_in_leaf=20, min_sum_hessian_in_leaf=1e-3, max_depth=-1)
+    base.update(kw)
+    return GrowerConfig(**base)
+
+
+def _serial_state(ds, grad, hess):
+    fm = {k: jnp.asarray(v) for k, v in ds.feature_meta_arrays().items()}
+    cfg = _cfg(ds)
+    return grow_tree(jnp.asarray(ds.binned), jnp.asarray(grad),
+                     jnp.asarray(hess), jnp.ones(ds.num_data, jnp.float32),
+                     jnp.ones(ds.num_features, bool),
+                     fm["num_bin"], fm["missing_type"], fm["default_bin"],
+                     fm["is_categorical"], cfg)
+
+
+def test_data_parallel_matches_serial(problem):
+    ds, grad, hess = problem
+    serial = _serial_state(ds, grad, hess)
+
+    mesh = make_mesh(axis_name="data")
+    grower = DataParallelGrower(mesh, _cfg(ds), axis="data")
+    fm = ds.feature_meta_arrays()
+    state = grower(jnp.asarray(ds.binned), jnp.asarray(grad), jnp.asarray(hess),
+                   jnp.ones(ds.num_data, jnp.float32),
+                   jnp.ones(ds.num_features, bool), fm)
+
+    assert int(state.num_leaves_used) == int(serial.num_leaves_used)
+    np.testing.assert_array_equal(np.asarray(state.node_feature),
+                                  np.asarray(serial.node_feature))
+    np.testing.assert_array_equal(np.asarray(state.node_threshold),
+                                  np.asarray(serial.node_threshold))
+    np.testing.assert_allclose(np.asarray(state.leaf_value),
+                               np.asarray(serial.leaf_value), rtol=1e-4, atol=1e-5)
+    np.testing.assert_array_equal(np.asarray(state.leaf_id),
+                                  np.asarray(serial.leaf_id))
+
+
+def test_feature_parallel_matches_serial(problem):
+    ds, grad, hess = problem
+    serial = _serial_state(ds, grad, hess)
+
+    mesh = make_mesh(axis_name="feature")
+    grower = FeatureParallelGrower(mesh, _cfg(ds), axis="feature")
+    fm = ds.feature_meta_arrays()
+    binned, fm = grower.pad_features(ds.binned, fm)
+    fmask = np.ones(binned.shape[1], bool)
+    state = grower(jnp.asarray(binned), jnp.asarray(grad), jnp.asarray(hess),
+                   jnp.ones(ds.num_data, jnp.float32), jnp.asarray(fmask), fm)
+
+    assert int(state.num_leaves_used) == int(serial.num_leaves_used)
+    np.testing.assert_array_equal(np.asarray(state.node_feature),
+                                  np.asarray(serial.node_feature))
+    np.testing.assert_array_equal(np.asarray(state.node_threshold),
+                                  np.asarray(serial.node_threshold))
+    np.testing.assert_allclose(np.asarray(state.leaf_value),
+                               np.asarray(serial.leaf_value), rtol=1e-4, atol=1e-5)
+
+
+def test_voting_parallel_runs(problem):
+    ds, grad, hess = problem
+    mesh = make_mesh(axis_name="data")
+    grower = VotingParallelGrower(mesh, _cfg(ds), axis="data")
+    fm = ds.feature_meta_arrays()
+    state = grower(jnp.asarray(ds.binned), jnp.asarray(grad), jnp.asarray(hess),
+                   jnp.ones(ds.num_data, jnp.float32),
+                   jnp.ones(ds.num_features, bool), fm)
+    assert int(state.num_leaves_used) > 1
+
+
+def test_distributed_training_end_to_end():
+    """Full GBDT training with tree_learner=data on the mesh."""
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(1)
+    n = 1024
+    X = rng.randn(n, 6)
+    y = (X[:, 0] > 0.3).astype(float)
+    params = {"objective": "binary", "tree_learner": "data",
+              "num_machines": 8, "verbose": -1}
+    gbm = lgb.train(params, lgb.Dataset(X, y), num_boost_round=10,
+                    verbose_eval=False)
+    pred = gbm.predict(X)
+    assert np.mean((pred > 0.5) == (y > 0)) > 0.95

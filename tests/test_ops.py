@@ -1,0 +1,204 @@
+"""Device op tests: histogram kernel and vectorized split finder against
+brute-force numpy references (the kernel-vs-reference equality tests
+SURVEY.md §4 calls for)."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from lightgbm_tpu.binning import MISSING_NAN, MISSING_NONE, MISSING_ZERO
+from lightgbm_tpu.ops.histogram import leaf_histogram, leaf_weights
+from lightgbm_tpu.ops.split import find_best_splits, leaf_output, leaf_split_gain
+
+
+def _np_histogram(binned, weights, num_bins):
+    n, f = binned.shape
+    out = np.zeros((f, num_bins, 3))
+    for j in range(f):
+        for b in range(num_bins):
+            mask = binned[:, j] == b
+            out[j, b] = weights[mask].sum(axis=0)
+    return out
+
+
+def test_histogram_matches_numpy():
+    rng = np.random.RandomState(0)
+    n, f, B = 512, 4, 16
+    binned = rng.randint(0, B, size=(n, f)).astype(np.int32)
+    g = rng.randn(n).astype(np.float32)
+    h = rng.rand(n).astype(np.float32)
+    w = np.stack([g, h, np.ones(n, np.float32)], axis=1)
+    hist = np.asarray(leaf_histogram(jnp.asarray(binned), jnp.asarray(w), B, chunk=128))
+    ref = _np_histogram(binned, w, B)
+    np.testing.assert_allclose(hist, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_histogram_masked_leaf():
+    rng = np.random.RandomState(1)
+    n, f, B = 256, 3, 8
+    binned = rng.randint(0, B, size=(n, f)).astype(np.int32)
+    g = rng.randn(n).astype(np.float32)
+    h = np.ones(n, np.float32)
+    leaf_id = rng.randint(0, 3, size=n).astype(np.int32)
+    bag = np.ones(n, np.float32)
+    w = np.asarray(leaf_weights(jnp.asarray(g), jnp.asarray(h),
+                                jnp.asarray(leaf_id), 1, jnp.asarray(bag)))
+    hist = np.asarray(leaf_histogram(jnp.asarray(binned), jnp.asarray(w), B, chunk=256))
+    sel = leaf_id == 1
+    ref = _np_histogram(binned[sel], np.stack(
+        [g[sel], h[sel], np.ones(sel.sum(), np.float32)], axis=1), B)
+    np.testing.assert_allclose(hist, ref, rtol=1e-5, atol=1e-5)
+
+
+def _np_best_split_no_missing(hist_f, pg, ph, pc, l1, l2, min_data, min_hess,
+                              min_gain):
+    """Brute force scan over thresholds, left = bins <= t."""
+    B = hist_f.shape[0]
+    parent_gain = max(abs(pg) - l1, 0.0) ** 2 / (ph + l2)
+    best = (-np.inf, -1)
+    for t in range(B - 1):
+        lg = hist_f[:t + 1, 0].sum()
+        lh = hist_f[:t + 1, 1].sum()
+        lc = hist_f[:t + 1, 2].sum()
+        rg, rh, rc = pg - lg, ph - lh, pc - lc
+        if lc < min_data or rc < min_data or lh < min_hess or rh < min_hess:
+            continue
+        gain = (max(abs(lg) - l1, 0.0) ** 2 / (lh + l2)
+                + max(abs(rg) - l1, 0.0) ** 2 / (rh + l2))
+        if gain - parent_gain - min_gain > best[0]:
+            best = (gain - parent_gain - min_gain, t)
+    return best
+
+
+def test_split_finder_matches_bruteforce():
+    rng = np.random.RandomState(2)
+    F, B = 5, 16
+    hist = rng.randn(F, B, 3).astype(np.float32)
+    hist[:, :, 1] = np.abs(hist[:, :, 1]) + 0.1   # positive hessians
+    hist[:, :, 2] = rng.randint(1, 50, size=(F, B))
+    pg = hist[0, :, 0].sum()
+    ph = hist[0, :, 1].sum()
+    pc = hist[0, :, 2].sum()
+    # make totals consistent across features
+    for j in range(1, F):
+        scale_g = pg / hist[j, :, 0].sum() if hist[j, :, 0].sum() != 0 else 1.0
+        hist[j, :, 0] *= scale_g
+        hist[j, :, 1] *= ph / hist[j, :, 1].sum()
+        hist[j, :, 2] *= pc / hist[j, :, 2].sum()
+
+    num_bin = np.full(F, B, np.int32)
+    missing = np.full(F, MISSING_NONE, np.int32)
+    default_bin = np.zeros(F, np.int32)
+    is_cat = np.zeros(F, bool)
+    res = find_best_splits(
+        jnp.asarray(hist), jnp.float32(pg), jnp.float32(ph), jnp.float32(pc),
+        jnp.asarray(num_bin), jnp.asarray(missing), jnp.asarray(default_bin),
+        jnp.asarray(is_cat),
+        lambda_l1=0.0, lambda_l2=0.01, min_gain_to_split=0.0,
+        min_data_in_leaf=1, min_sum_hessian_in_leaf=1e-3)
+    for j in range(F):
+        ref_gain, ref_t = _np_best_split_no_missing(
+            hist[j], pg, ph, pc, 0.0, 0.01, 1, 1e-3, 0.0)
+        got_gain = float(res.gain[j])
+        if ref_gain == -np.inf:
+            assert got_gain == -np.inf
+        else:
+            assert got_gain == pytest.approx(ref_gain, rel=1e-3, abs=1e-3)
+            assert int(res.threshold[j]) == ref_t
+
+
+def test_split_left_right_sums_consistent():
+    rng = np.random.RandomState(3)
+    F, B = 3, 8
+    hist = np.abs(rng.randn(F, B, 3)).astype(np.float32)
+    hist[:, :, 2] = rng.randint(5, 20, size=(F, B))
+    pg = float(hist[0, :, 0].sum())
+    ph = float(hist[0, :, 1].sum())
+    pc = float(hist[0, :, 2].sum())
+    for j in range(1, F):
+        hist[j] *= np.array([pg, ph, pc]) / hist[j].sum(axis=0)
+    res = find_best_splits(
+        jnp.asarray(hist), jnp.float32(pg), jnp.float32(ph), jnp.float32(pc),
+        jnp.asarray(np.full(F, B, np.int32)),
+        jnp.asarray(np.zeros(F, np.int32)),
+        jnp.asarray(np.zeros(F, np.int32)),
+        jnp.asarray(np.zeros(F, bool)),
+        lambda_l1=0.0, lambda_l2=0.0, min_gain_to_split=0.0,
+        min_data_in_leaf=1, min_sum_hessian_in_leaf=1e-3)
+    for j in range(F):
+        if np.isfinite(float(res.gain[j])):
+            assert float(res.left_count[j]) + float(res.right_count[j]) == \
+                pytest.approx(pc, rel=1e-5)
+            assert float(res.left_sum_g[j]) + float(res.right_sum_g[j]) == \
+                pytest.approx(pg, rel=1e-4, abs=1e-4)
+
+
+def test_nan_missing_dual_direction():
+    """With a NaN bin holding strong gradient mass, default-left must win
+    when grouping NaN with the low bins is better."""
+    B = 8
+    hist = np.zeros((1, B, 3), np.float32)
+    # bins 0-2: negative grads; bins 3-6: positive; bin 7 = NaN bin, negative
+    hist[0, 0:3, 0] = -5.0
+    hist[0, 3:7, 0] = +5.0
+    hist[0, 7, 0] = -20.0
+    hist[0, :, 1] = 1.0
+    hist[0, :, 2] = 10.0
+    pg = float(hist[0, :, 0].sum())
+    ph = float(hist[0, :, 1].sum())
+    pc = float(hist[0, :, 2].sum())
+    res = find_best_splits(
+        jnp.asarray(hist), jnp.float32(pg), jnp.float32(ph), jnp.float32(pc),
+        jnp.asarray([B], dtype=jnp.int32),
+        jnp.asarray([MISSING_NAN], dtype=jnp.int32),
+        jnp.asarray([0], dtype=jnp.int32),
+        jnp.asarray([False]),
+        lambda_l1=0.0, lambda_l2=0.0, min_gain_to_split=0.0,
+        min_data_in_leaf=1, min_sum_hessian_in_leaf=1e-3)
+    assert bool(res.default_left[0])
+    assert int(res.threshold[0]) == 2  # split between negative and positive
+
+
+def test_categorical_one_vs_rest():
+    B = 6
+    hist = np.zeros((1, B, 3), np.float32)
+    hist[0, :, 0] = [1.0, 1.0, -30.0, 1.0, 1.0, 1.0]
+    hist[0, :, 1] = 5.0
+    hist[0, :, 2] = 20.0
+    pg, ph, pc = (float(hist[0, :, i].sum()) for i in range(3))
+    res = find_best_splits(
+        jnp.asarray(hist), jnp.float32(pg), jnp.float32(ph), jnp.float32(pc),
+        jnp.asarray([B], dtype=jnp.int32),
+        jnp.asarray([MISSING_NONE], dtype=jnp.int32),
+        jnp.asarray([0], dtype=jnp.int32),
+        jnp.asarray([True]),
+        lambda_l1=0.0, lambda_l2=0.0, min_gain_to_split=0.0,
+        min_data_in_leaf=1, min_sum_hessian_in_leaf=1e-3)
+    assert bool(res.is_categorical[0])
+    assert int(res.threshold[0]) == 2  # category 2 isolated
+    assert not bool(res.default_left[0])
+
+
+def test_min_data_in_leaf_blocks_split():
+    B = 4
+    hist = np.zeros((1, B, 3), np.float32)
+    hist[0, :, 0] = [-10, 10, -10, 10]
+    hist[0, :, 1] = 1.0
+    hist[0, :, 2] = 3.0  # 12 total, min_data 10 -> no valid split
+    pg, ph, pc = (float(hist[0, :, i].sum()) for i in range(3))
+    res = find_best_splits(
+        jnp.asarray(hist), jnp.float32(pg), jnp.float32(ph), jnp.float32(pc),
+        jnp.asarray([B], dtype=jnp.int32),
+        jnp.asarray([MISSING_NONE], dtype=jnp.int32),
+        jnp.asarray([0], dtype=jnp.int32),
+        jnp.asarray([False]),
+        lambda_l1=0.0, lambda_l2=0.0, min_gain_to_split=0.0,
+        min_data_in_leaf=10, min_sum_hessian_in_leaf=1e-3)
+    assert float(res.gain[0]) == -np.inf
+
+
+def test_leaf_output_formula():
+    # -sign(G) * max(|G|-l1, 0) / (H + l2), hpp:220-225
+    assert float(leaf_output(4.0, 2.0, 1.0, 1.0)) == pytest.approx(-1.0)
+    assert float(leaf_output(-4.0, 2.0, 1.0, 1.0)) == pytest.approx(1.0)
+    assert float(leaf_output(0.5, 2.0, 1.0, 0.0)) == pytest.approx(0.0)

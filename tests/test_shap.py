@@ -1,0 +1,71 @@
+"""TreeSHAP correctness against brute-force Shapley enumeration
+(reference path: Tree::PredictContrib, tree.cpp:522-633)."""
+import itertools
+import math
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.shap import _tree_shap
+
+
+def _brute_force_shapley(tree, row, num_features):
+    def cond_exp(S, node=0):
+        if node < 0:
+            return tree.leaf_value[~node]
+        f = tree.split_feature[node]
+
+        def cnt(n):
+            return tree.leaf_count[~n] if n < 0 else tree.internal_count[n]
+
+        l, r = int(tree.left_child[node]), int(tree.right_child[node])
+        if f in S:
+            go_left = row[f] <= tree.threshold[node]
+            return cond_exp(S, l if go_left else r)
+        wl, wr = cnt(l), cnt(r)
+        return (wl * cond_exp(S, l) + wr * cond_exp(S, r)) / (wl + wr)
+
+    phi = np.zeros(num_features + 1)
+    phi[-1] = cond_exp(set())
+    for i in range(num_features):
+        others = [j for j in range(num_features) if j != i]
+        for r in range(num_features):
+            for S in itertools.combinations(others, r):
+                S = set(S)
+                w = (math.factorial(len(S)) * math.factorial(num_features - len(S) - 1)
+                     / math.factorial(num_features))
+                phi[i] += w * (cond_exp(S | {i}) - cond_exp(S))
+    return phi
+
+
+@pytest.mark.parametrize("seed,num_leaves", [(0, 4), (1, 8), (2, 16)])
+def test_tree_shap_matches_bruteforce(seed, num_leaves):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(400, 3)
+    # nonlinear in f0 so trees revisit features along a path (exercises
+    # the UNWIND branch)
+    y = np.sin(X[:, 0] * 2) + 0.3 * X[:, 1] + 0.05 * X[:, 2]
+    gbm = lgb.train({"objective": "regression", "verbose": -1,
+                     "min_data_in_leaf": 5, "num_leaves": num_leaves},
+                    lgb.Dataset(X, y), num_boost_round=1, verbose_eval=False)
+    tree = gbm._inner.models[0]
+    for r in range(5):
+        exact = _brute_force_shapley(tree, X[r], 3)
+        mine = np.zeros(4)
+        _tree_shap(tree, X[r], mine)
+        np.testing.assert_allclose(mine, exact, rtol=1e-6, atol=1e-8)
+
+
+def test_shap_efficiency_multiclass():
+    rng = np.random.RandomState(3)
+    X = rng.randn(300, 4)
+    y = (X[:, 0] + X[:, 1] > 0).astype(int) + (X[:, 2] > 1).astype(int)
+    gbm = lgb.train({"objective": "multiclass", "num_class": 3, "verbose": -1,
+                     "min_data_in_leaf": 5}, lgb.Dataset(X, y),
+                    num_boost_round=4, verbose_eval=False)
+    contrib = gbm.predict(X[:8], pred_contrib=True)
+    raw = gbm.predict(X[:8], raw_score=True)
+    k, nf = 3, 4
+    contrib = contrib.reshape(8, k, nf + 1)
+    np.testing.assert_allclose(contrib.sum(axis=2), raw, rtol=1e-4, atol=1e-4)
