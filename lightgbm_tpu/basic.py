@@ -15,7 +15,8 @@ import numpy as np
 
 from . import log
 from .boosting import create_boosting
-from .config import Config, key_alias_transform, params_str2map
+from .config import (Config, _parse_value, key_alias_transform,
+                     params_str2map)
 from .dataset import Dataset as _InnerDataset
 from .metrics import default_metric_for_objective
 from .objectives import create_objective
@@ -136,7 +137,13 @@ class Dataset:
             zero_as_missing=bool(params.get("zero_as_missing", False)),
             feature_names=feature_names,
             weight=weight, group=group, init_score=init_score,
-            reference=ref_inner, keep_raw=not self.free_raw_data)
+            reference=ref_inner, keep_raw=not self.free_raw_data,
+            # EFB (dataset.cpp:66-211); feature-parallel shards features
+            # 1:1 onto stored columns, so bundling is disabled there
+            enable_bundle=(_parse_value(params.get("enable_bundle", True), bool)
+                           and params.get("tree_learner", "serial") != "feature"),
+            max_conflict_rate=float(params.get("max_conflict_rate", 0.0)),
+            sparse_threshold=float(params.get("sparse_threshold", 0.8)))
         return self._inner
 
     def construct(self) -> "Dataset":

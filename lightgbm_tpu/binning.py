@@ -154,6 +154,13 @@ class BinMapper:
         self.max_val: float = 0.0
         self.default_bin: int = 0  # bin of value 0.0
 
+    def bin_info(self) -> str:
+        """Reference: BinMapper::bin_info (bin.h:175-184) — the per-feature
+        `feature_infos=` entry in the model text header."""
+        if self.bin_type == BIN_CATEGORICAL:
+            return ":".join(str(int(c)) for c in self.bin_2_categorical)
+        return "[%s:%s]" % (repr(self.min_val), repr(self.max_val))
+
     # ------------------------------------------------------------------
     def find_bin(self, values: np.ndarray, total_sample_cnt: int, max_bin: int,
                  min_data_in_bin: int = 3, min_split_data: int = 0,

@@ -89,6 +89,7 @@ class GBDT:
             self.num_tree_per_iteration = self.num_class
         self.max_feature_idx = train_data.num_total_features - 1
         self.feature_names = list(train_data.feature_names)
+        self.feature_infos_ = train_data.feature_infos()
 
         n = train_data.num_data
         f = train_data.num_features
@@ -101,7 +102,11 @@ class GBDT:
         self._num_shards = ndev
 
         chunk = min(self.config.tree.tpu_hist_chunk, 1 << 20)
-        # pick a chunk that bounds the one-hot working set; pad rows up
+        # bound the histogram pass working set (one-hot is [chunk, G, B]):
+        # cap chunk so chunk*G*B stays within a fused-friendly budget
+        gb = max(1, train_data.num_groups * train_data.max_num_bin())
+        ws_cap = max(256, 1 << int(np.floor(np.log2(max(1, (1 << 26) // gb)))))
+        chunk = min(chunk, ws_cap)
         self._chunk = int(min(chunk, max(256, 1 << int(np.ceil(np.log2(max(n, 1)))))))
         row_multiple = self._chunk * (ndev if self._tree_learner_kind in
                                       ("data", "voting") else 1)
@@ -149,6 +154,9 @@ class GBDT:
         self._grower_cfg = GrowerConfig(
             num_leaves=self.config.tree.num_leaves,
             max_bins=self._max_bins,
+            feature_bins=int(train_data.num_bins_per_feature().max(initial=1)),
+            batch_k=self.config.tree.tpu_batch_k,
+            hist_bf16=self.config.tree.tpu_hist_bf16,
             chunk=self._chunk,
             lambda_l1=self.config.tree.lambda_l1,
             lambda_l2=self.config.tree.lambda_l2,
@@ -176,14 +184,23 @@ class GBDT:
                 self._dist_grower = cls(mesh, self._grower_cfg, axis="data")
             log.info("Using %s-parallel tree learner over %d devices",
                      self._tree_learner_kind, ndev)
+        if (self._tree_learner_kind == "feature"
+                and train_data.groups is not None
+                and train_data.num_groups != train_data.num_features):
+            log.fatal("feature-parallel requires unbundled features; "
+                      "construct the Dataset with enable_bundle=false")
         self._binned = jnp.asarray(binned_host)
-        self._num_features_padded = binned_host.shape[1]
+        # logical (possibly shard-padded) feature count for feature_fraction
+        # masks; the stored binned width is the GROUP count (EFB)
+        self._num_features_padded = int(fm["num_bin"].shape[0])
         self._fmeta = {k: jnp.asarray(v) for k, v in fm.items()}
 
         self._feature_rng = np.random.RandomState(self.config.tree.feature_fraction_seed)
         self._bagging_rng = np.random.RandomState(self.config.boosting.bagging_seed)
 
-        # boost from average (gbdt.cpp:358-378)
+        # boost from average (gbdt.cpp:358-378): the score bump happens at
+        # init; the bias itself is folded into the first trained tree via
+        # AddBias (gbdt.cpp:446) so the saved model is self-contained
         if (objective is not None and objective.boost_from_average()
                 and self.config.objective_config.boost_from_average
                 and self.num_tree_per_iteration == 1):
@@ -191,6 +208,7 @@ class GBDT:
             if self.init_score_bias != 0.0:
                 self._score = self._score + self.init_score_bias
                 log.info("Start training from score %f", self.init_score_bias)
+        self._pending_bias = self.init_score_bias
 
     def add_valid(self, valid_data: Dataset, name: str,
                   metric_names: Sequence[str] = ()) -> None:
@@ -267,11 +285,10 @@ class GBDT:
         if self._dist_grower is not None:
             return self._dist_grower(self._binned, grad, hess, row_weight,
                                      jnp.asarray(feature_mask), self._fmeta)
+        from ..learner.grow import FMETA_KEYS
         return grow_tree(
             self._binned, grad, hess, row_weight, jnp.asarray(feature_mask),
-            self._fmeta["num_bin"], self._fmeta["missing_type"],
-            self._fmeta["default_bin"], self._fmeta["is_categorical"],
-            self._grower_cfg)
+            *[self._fmeta[k] for k in FMETA_KEYS], self._grower_cfg)
 
     # ------------------------------------------------------------------
     def _compute_gradients(self, score) -> Tuple:
@@ -322,6 +339,12 @@ class GBDT:
                 for vi in range(len(self.valid_sets)):
                     self._valid_score[vi] = self._valid_score[vi].at[cls].add(
                         predict_value_binned(dtree, self._valid_binned[vi]))
+                # fold boost-from-average into the tree AFTER the score
+                # update (scores were bumped at init): gbdt.cpp:445-447
+                if abs(getattr(self, "_pending_bias", 0.0)) > _K_EPSILON:
+                    tree.add_bias(self._pending_bias)
+                    self._pending_bias = 0.0
+                    self.init_score_bias = 0.0
             self.models.append(tree)
 
         self.iter_ += 1
@@ -459,7 +482,13 @@ class GBDT:
         if self.average_output:
             out.append("average_output")
         out.append("feature_names=" + " ".join(self.feature_names))
-        out.append(f"init_score_bias={self.init_score_bias}")
+        out.append("feature_infos=" + " ".join(
+            getattr(self, "feature_infos_", None)
+            or ["none"] * (self.max_feature_idx + 1)))
+        if self.init_score_bias != 0.0:
+            # only reachable for models loaded from old-format files; new
+            # models carry the bias inside the first tree (AddBias)
+            out.append(f"init_score_bias={self.init_score_bias}")
         out.append("")
         total = len(self.models)
         if num_iteration > 0:
@@ -514,6 +543,7 @@ class GBDT:
         self.num_tree_per_iteration = int(kv.get("num_tree_per_iteration", self.num_class))
         self.max_feature_idx = int(kv.get("max_feature_idx", 0))
         self.feature_names = kv.get("feature_names", "").split()
+        self.feature_infos_ = kv.get("feature_infos", "").split()
         self.init_score_bias = float(kv.get("init_score_bias", 0.0))
         self.average_output = "average_output" in kv
         self.models = [Tree.from_string("\n".join(b)) for b in tree_blocks]

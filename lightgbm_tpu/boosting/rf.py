@@ -39,6 +39,7 @@ class RF(GBDT):
         if self.init_score_bias != 0.0:
             self._score = self._score - self.init_score_bias
             self.init_score_bias = 0.0
+        self._pending_bias = 0.0
         # gradients from the zero score, fixed for all iterations
         import jax.numpy as jnp
         k = self.num_tree_per_iteration

@@ -166,6 +166,11 @@ class TreeConfig:
     gpu_use_dp: bool = False
     tpu_hist_chunk: int = 16384
     tpu_double_precision: bool = False
+    # pending-leaf histogram batching (learner/grow.py prefetch); 1 =
+    # one data pass per split
+    tpu_batch_k: int = 16
+    # bf16 hi+lo MXU histogram contraction (ops/histogram.py)
+    tpu_hist_bf16: bool = True
 
 
 @dataclass

@@ -108,7 +108,7 @@ class Dataset:
     """
 
     def __init__(self):
-        self.binned: Optional[np.ndarray] = None
+        self.binned: Optional[np.ndarray] = None  # [num_data, num_groups]
         self.raw: Optional[np.ndarray] = None  # kept optionally for valid-set binning
         self.mappers: List[BinMapper] = []
         self.metadata = Metadata()
@@ -116,6 +116,7 @@ class Dataset:
         self.used_features: List[int] = []
         self.num_total_features: int = 0
         self.max_bin: int = 255
+        self.groups = None  # efb.FeatureGroups over used features
 
     # ------------------------------------------------------------------
     @classmethod
@@ -131,7 +132,10 @@ class Dataset:
                    group: Optional[Sequence[int]] = None,
                    init_score: Optional[Sequence[float]] = None,
                    reference: Optional["Dataset"] = None,
-                   keep_raw: bool = False) -> "Dataset":
+                   keep_raw: bool = False,
+                   enable_bundle: bool = True,
+                   max_conflict_rate: float = 0.0,
+                   sparse_threshold: float = 0.8) -> "Dataset":
         """Build a Dataset from a dense float matrix.
 
         When `reference` is given, its BinMappers are reused so validation
@@ -154,6 +158,7 @@ class Dataset:
                           % (f, reference.num_total_features))
             ds.mappers = reference.mappers
             ds.used_features = reference.used_features
+            ds.groups = reference.groups
         else:
             ds.mappers = find_bin_mappers(
                 data.astype(np.float64, copy=False), max_bin, min_data_in_bin,
@@ -168,8 +173,18 @@ class Dataset:
         for j in ds.used_features:
             cols.append(ds.mappers[j].values_to_bins(
                 np.asarray(data[:, j], dtype=np.float64)))
-        ds.binned = (np.stack(cols, axis=1).astype(np.int32) if cols
-                     else np.zeros((n, 0), dtype=np.int32))
+        num_bins = np.asarray(
+            [ds.mappers[j].num_bin for j in ds.used_features], np.int32)
+        default_bins = np.asarray(
+            [ds.mappers[j].default_bin for j in ds.used_features], np.int32)
+        if ds.groups is None:
+            from .efb import find_groups
+            ds.groups = find_groups(
+                cols, default_bins, num_bins, enable_bundle=enable_bundle,
+                max_conflict_rate=max_conflict_rate,
+                sparse_threshold=sparse_threshold, seed=data_random_seed)
+        ds.binned = (ds.groups.bundle_rows(cols, default_bins) if cols
+                     else np.zeros((n, 0), dtype=np.uint8))
         if keep_raw:
             ds.raw = data
         ds.metadata = Metadata(n)
@@ -190,11 +205,27 @@ class Dataset:
 
     @property
     def num_features(self) -> int:
-        """Number of used (non-trivial) features."""
+        """Number of used (non-trivial) LOGICAL features (the stored
+        `binned` width is num_groups <= num_features after EFB)."""
+        return len(self.used_features)
+
+    @property
+    def num_groups(self) -> int:
         return 0 if self.binned is None else self.binned.shape[1]
+
+    @property
+    def has_bundles(self) -> bool:
+        return self.groups is not None and bool(self.groups.is_bundled.any())
 
     def feature_mapper(self, inner_idx: int) -> BinMapper:
         return self.mappers[self.used_features[inner_idx]]
+
+    def feature_infos(self) -> List[str]:
+        """Per-ORIGINAL-column info strings for the model text header
+        (reference: Dataset::feature_infos, dataset.h:518-530)."""
+        used = set(self.used_features)
+        return [self.mappers[j].bin_info() if j in used else "none"
+                for j in range(self.num_total_features)]
 
     def real_feature_index(self, inner_idx: int) -> int:
         return self.used_features[inner_idx]
@@ -204,11 +235,20 @@ class Dataset:
                            for j in range(self.num_features)], dtype=np.int32)
 
     def max_num_bin(self) -> int:
+        """Histogram width: max bins over stored GROUPS (feature-space
+        scans use per-feature num_bin from feature_meta_arrays)."""
+        if self.groups is not None and self.groups.num_groups:
+            return int(self.groups.group_num_bin.max())
         nb = self.num_bins_per_feature()
         return int(nb.max()) if len(nb) else 1
 
     def feature_meta_arrays(self) -> Dict[str, np.ndarray]:
-        """Static per-feature metadata consumed by the device split finder."""
+        """Static per-feature metadata consumed by the device split finder.
+
+        Includes the EFB layout: `group` / `offset` locate each feature's
+        bin slice inside the stored group columns; `is_bundled` marks
+        features whose default-bin mass must be reconstructed from leaf
+        totals (FixHistogram, dataset.cpp:747-767)."""
         f = self.num_features
         num_bin = np.zeros(f, dtype=np.int32)
         missing_type = np.zeros(f, dtype=np.int32)
@@ -220,8 +260,17 @@ class Dataset:
             missing_type[j] = m.missing_type
             default_bin[j] = m.default_bin
             is_categorical[j] = m.bin_type == BIN_CATEGORICAL
+        if self.groups is not None and f:
+            group = self.groups.group_of.astype(np.int32)
+            offset = self.groups.offset_of.astype(np.int32)
+            is_bundled = self.groups.is_bundled.copy()
+        else:
+            group = np.arange(f, dtype=np.int32)
+            offset = np.zeros(f, dtype=np.int32)
+            is_bundled = np.zeros(f, dtype=bool)
         return {"num_bin": num_bin, "missing_type": missing_type,
-                "default_bin": default_bin, "is_categorical": is_categorical}
+                "default_bin": default_bin, "is_categorical": is_categorical,
+                "group": group, "offset": offset, "is_bundled": is_bundled}
 
     # ------------------------------------------------------------------
     # binary serialization (reference: Dataset::SaveBinaryFile, dataset.h:386,
@@ -234,6 +283,8 @@ class Dataset:
             "num_total_features": self.num_total_features,
             "max_bin": self.max_bin,
             "mappers": [m.to_dict() for m in self.mappers],
+            "groups": ([[int(j) for j in g] for g in self.groups.groups]
+                       if self.groups is not None else None),
         }
         meta_bytes = json.dumps(meta).encode()
         with open(filename, "wb") as fh:
@@ -267,6 +318,12 @@ class Dataset:
             ds.num_total_features = int(meta["num_total_features"])
             ds.max_bin = int(meta["max_bin"])
             ds.mappers = [BinMapper.from_dict(d) for d in meta["mappers"]]
+            if meta.get("groups") is not None:
+                from .efb import FeatureGroups
+                num_bins = np.asarray(
+                    [ds.mappers[j].num_bin for j in ds.used_features], np.int32)
+                ds.groups = FeatureGroups(
+                    [[int(j) for j in g] for g in meta["groups"]], num_bins)
             arrays = []
             for _ in range(5):
                 code = fh.read(1)

@@ -1,0 +1,205 @@
+"""Exclusive Feature Bundling (EFB).
+
+TPU-native equivalent of the reference's feature-group construction
+(`src/io/dataset.cpp:66-211` — `FindGroups` greedy conflict-bounded graph
+coloring + `FastFeatureBundling`): mutually-(almost-)exclusive sparse
+features share ONE stored column, so the dense `[rows, groups]` uint8
+matrix stays narrow on Bosch/Expo-class sparse data. This is the entire
+sparse story of the TPU design (dense bins + EFB replace the reference's
+sparse/ordered bin variants, SURVEY.md §7).
+
+Layout per multi-feature group (g):
+  bin 0                                  = every member feature at default
+  bins [offset_j, offset_j + num_bin_j)  = feature j's own bin space,
+                                           shifted by offset_j
+A row stores the bin of its (at most one, up to the tolerated conflict
+rate) non-default member; on conflict the later feature in the group wins
+— the same lossy tolerance the reference accepts (max_conflict_rate,
+dataset.cpp:99-125). Feature j's histogram is the group histogram slice
+[offset_j : offset_j + num_bin_j); its default-bin mass is reconstructed
+from leaf totals (the FixHistogram trick, dataset.cpp:747-767).
+
+Single-feature groups store the feature's bins unshifted (offset 0) and
+need no reconstruction.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from . import log
+
+DEFAULT_MAX_GROUP_BINS = 256  # uint8 storage; reference GPU has the same cap
+
+
+def pick_max_group_bins(num_bins: np.ndarray) -> int:
+    """Bundle-capacity heuristic. The reference CPU bundles without a bin
+    cap (uint16/uint32 Bin variants); its GPU caps at 256. We pay for the
+    histogram width of the WIDEST group on every group (padded one-hot), so
+    the cap trades bundle count against padding waste: allow ~16 features
+    per bundle, minimum 256 (uint8), capped at 2048 (uint16)."""
+    if len(num_bins) == 0:
+        return DEFAULT_MAX_GROUP_BINS
+    return int(max(DEFAULT_MAX_GROUP_BINS,
+                   min(2048, 16 * (int(num_bins.max()) + 1))))
+
+
+class FeatureGroups:
+    """Static feature->group layout.
+
+    Attributes (F = number of used features, G = number of groups):
+      group_of:    [F] group index of each feature
+      offset_of:   [F] bin offset of the feature inside its group
+      is_bundled:  [F] True when the feature shares its group (histogram
+                   default-bin mass must be reconstructed)
+      group_num_bin: [G] total bins of each group
+      groups:      list of member-feature lists
+    """
+
+    def __init__(self, groups: List[List[int]], num_bins: np.ndarray):
+        f = int(num_bins.shape[0])
+        self.groups = groups
+        self.group_of = np.zeros(f, np.int32)
+        self.offset_of = np.zeros(f, np.int32)
+        self.is_bundled = np.zeros(f, bool)
+        self.group_num_bin = np.zeros(len(groups), np.int32)
+        for g, members in enumerate(groups):
+            if len(members) == 1:
+                j = members[0]
+                self.group_of[j] = g
+                self.offset_of[j] = 0
+                self.group_num_bin[g] = num_bins[j]
+                continue
+            off = 1  # bin 0 = all members at default
+            for j in members:
+                self.group_of[j] = g
+                self.offset_of[j] = off
+                self.is_bundled[j] = True
+                off += int(num_bins[j])
+            self.group_num_bin[g] = off
+
+    @property
+    def num_groups(self) -> int:
+        return len(self.groups)
+
+    def to_dict(self) -> dict:
+        return {"groups": [[int(j) for j in g] for g in self.groups],
+                "num_bins": [0] * 0}  # groups are sufficient to rebuild
+
+    # ------------------------------------------------------------------
+    def bundle_rows(self, feature_bins: List[np.ndarray],
+                    default_bins: np.ndarray) -> np.ndarray:
+        """Build the [N, G] group-bin matrix from per-feature bin columns.
+
+        feature_bins[j]: [N] integer bins of used feature j.
+        """
+        n = len(feature_bins[0]) if feature_bins else 0
+        dtype = np.uint8 if int(self.group_num_bin.max(initial=1)) <= 256 \
+            else np.uint16
+        out = np.zeros((n, self.num_groups), dtype)
+        for g, members in enumerate(self.groups):
+            if len(members) == 1:
+                j = members[0]
+                out[:, g] = feature_bins[j].astype(dtype)
+                continue
+            col = np.zeros(n, np.int32)
+            for j in members:
+                nz = feature_bins[j] != default_bins[j]
+                # conflict rule: later member wins (bounded by
+                # max_conflict_rate at grouping time)
+                col[nz] = self.offset_of[j] + feature_bins[j][nz]
+            out[:, g] = col.astype(dtype)
+        return out
+
+
+def find_groups(feature_bins: List[np.ndarray], default_bins: np.ndarray,
+                num_bins: np.ndarray, *, enable_bundle: bool = True,
+                max_conflict_rate: float = 0.0,
+                sparse_threshold: float = 0.8,
+                sample_cnt: int = 50_000, seed: int = 1,
+                max_group_bins: Optional[int] = None) -> FeatureGroups:
+    """Greedy conflict-bounded grouping (reference: FindGroups,
+    dataset.cpp:66-139).
+
+    Features whose sampled non-default rate exceeds 1 - sparse_threshold
+    are dense: each gets its own group. Sparse features are ordered by
+    non-default count (descending) and greedily placed into the first
+    group whose accumulated conflict stays within max_conflict_rate * n
+    and whose bin capacity stays within MAX_GROUP_BINS.
+    """
+    f = len(feature_bins)
+    if f == 0:
+        return FeatureGroups([], num_bins)
+    n = len(feature_bins[0])
+    if not enable_bundle or f == 1:
+        return FeatureGroups([[j] for j in range(f)], num_bins)
+    if max_group_bins is None:
+        max_group_bins = pick_max_group_bins(num_bins)
+
+    rng = np.random.RandomState(seed)
+    if n > sample_cnt:
+        sample = rng.choice(n, size=sample_cnt, replace=False)
+        sample.sort()
+    else:
+        sample = np.arange(n)
+    s = len(sample)
+
+    nz_masks = [feature_bins[j][sample] != default_bins[j] for j in range(f)]
+    nz_counts = np.asarray([int(m.sum()) for m in nz_masks])
+
+    dense = nz_counts > (1.0 - sparse_threshold) * s
+    budget = max_conflict_rate * s
+
+    # bigger-nonzero-count-first ordering (the reference tries natural and
+    # count order and keeps the smaller grouping, dataset.cpp:174-178; the
+    # count order wins in practice)
+    order = np.argsort(-nz_counts, kind="stable")
+    groups: List[List[int]] = []
+    gmasks: List[np.ndarray] = []
+    gconflict: List[float] = []
+    gbins: List[int] = []
+    gnz: List[int] = []
+    for j in order:
+        j = int(j)
+        if dense[j]:
+            groups.append([j])
+            gmasks.append(None)
+            gconflict.append(np.inf)
+            gbins.append(int(num_bins[j]))
+            gnz.append(s)
+            continue
+        placed = False
+        for g in range(len(groups)):
+            if gmasks[g] is None:
+                continue
+            if gbins[g] + int(num_bins[j]) > max_group_bins:
+                continue
+            # exclusivity budget (dataset.cpp:89-91): the group's total
+            # non-default rows may not exceed the sample (+ tolerated error)
+            if gnz[g] + int(nz_counts[j]) > s + budget:
+                continue
+            overlap = int((gmasks[g] & nz_masks[j]).sum())
+            if gconflict[g] + overlap <= budget:
+                groups[g].append(j)
+                gmasks[g] = gmasks[g] | nz_masks[j]
+                gconflict[g] += overlap
+                gbins[g] += int(num_bins[j])
+                gnz[g] += int(nz_counts[j]) - overlap
+                placed = True
+                break
+        if not placed:
+            groups.append([j])
+            gmasks.append(nz_masks[j].copy())
+            gconflict.append(0.0)
+            gbins.append(1 + int(num_bins[j]))
+            gnz.append(int(nz_counts[j]))
+
+    # demote 1-member "bundles" to plain groups (no reserved bin 0)
+    fg = FeatureGroups(groups, num_bins)
+    n_bundled = sum(1 for g in groups if len(g) > 1)
+    if n_bundled:
+        log.info("EFB bundled %d features into %d groups "
+                 "(%d multi-feature bundles)",
+                 f, fg.num_groups, n_bundled)
+    return fg

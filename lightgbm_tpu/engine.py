@@ -126,10 +126,15 @@ def _continue_from(booster: Booster, init_booster: Booster, train_set: Dataset):
     if inner.init_score_bias != 0.0:
         inner._score = inner._score - inner.init_score_bias
     inner.init_score_bias = init_inner.init_score_bias
-    # models from reference-format text lack bin-space metadata; rebuild it
-    # from the training dataset's mappers before binned replay
+    # the loaded trees already carry any boost-from-average bias (AddBias
+    # folds it into the first tree) — nothing further to fold
+    inner._pending_bias = 0.0
+    # models from reference-format text lack bin-space metadata, and text
+    # never carries the EFB group locators; rebuild from the training
+    # dataset's mappers before binned replay
     for tree in inner.models:
-        if tree.num_leaves > 1 and not tree.has_bin_metadata:
+        if tree.num_leaves > 1 and (not tree.has_bin_metadata
+                                    or inner.train_data.has_bundles):
             tree.attach_bin_metadata(inner.train_data)
     from .boosting.gbdt import _jit_forest_binned
     from .ops.predict import stack_trees
@@ -208,6 +213,7 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
             if early_stopping_rounds and i >= early_stopping_rounds:
                 keys = [k for k in results if k.endswith("-mean")]
                 stop = True
+                first_best = None
                 for k in keys:
                     hist = results[k]
                     base = k[:-5]
@@ -215,11 +221,16 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
                         best = int(np.argmax(hist))
                     else:
                         best = int(np.argmin(hist))
+                    if first_best is None:
+                        first_best = best  # first metric anchors truncation
                     if i - best < early_stopping_rounds:
                         stop = False
                 if stop:
+                    # truncate every history at the FIRST metric's best
+                    # iteration (consistent with the callback-based early
+                    # stopping, which tracks the first metric)
                     for k in list(results.keys()):
-                        results[k] = results[k][:best + 1]
+                        results[k] = results[k][:first_best + 1]
                     break
     except callback_mod.EarlyStopException:
         pass

@@ -11,11 +11,23 @@ pool, and host loops. Here the entire `num_leaves-1` split loop is ONE
 - all active-leaf histograms live in a dense `[L, F, B, 3]` HBM pool
   (replaces the size-bounded HistogramPool, feature_histogram.hpp:380-548 —
   HBM is plentiful, rematerialization unnecessary);
-- the smaller child's histogram is built by masked reduction; the larger is
-  parent − smaller (the subtraction trick, serial_tree_learner.cpp:482-487);
 - best-split finding is the vectorized [F, B] scan (ops/split.py) followed
   by an argmax over features, replacing per-feature OMP loops
   (serial_tree_learner.cpp:451-516).
+
+Histogram batching (the round-2 redesign): the reference touches only the
+smaller child's rows per split (dense_bin.hpp:66-133), which a fixed-shape
+masked reduction cannot — every pass costs O(N). Instead of one pass per
+split, we exploit that a leaf's cached best split fully determines its
+children's row sets BEFORE the leaf is committed: a single batched pass
+builds the smaller-child histograms of up to `batch_k` pending leaves at
+once (one-hot-over-bins x leaf-member-weights einsum whose MXU N-dimension
+is batch_k*3 instead of 3), the larger children come from the parent-minus-
+smaller subtraction trick (serial_tree_learner.cpp:482-487), and their best
+splits are cached parent-indexed. The sequential best-first commit loop is
+unchanged — trees are IDENTICAL to the one-pass-per-split grower — but a
+data pass now happens only when the argmax leaf's children were not yet
+prefetched: ~(num_leaves/batch_k) passes per tree on bushy trees.
 
 `lax.cond` keeps iterations after growth stops (all gains <= 0) nearly
 free. One compile per (N, F, B, L, hyperparam) signature, reused across
@@ -24,18 +36,15 @@ trees and boosting iterations.
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 
+from ..binning import MISSING_NAN, MISSING_ZERO
 from ..ops import histogram as hist_ops
 from ..ops import split as split_ops
-from ..ops.predict import DeviceTree
 from ..ops.split import leaf_output
-
-
-from typing import Optional
 
 
 class GrowerConfig(NamedTuple):
@@ -53,6 +62,15 @@ class GrowerConfig(NamedTuple):
       — replacing SyncUpGlobalBestSplit (parallel_tree_learner.h:184-207).
     - num_feature_shards: size of feature_axis (features must be padded to
       a multiple of it host-side).
+    - batch_k: number of pending leaves whose child histograms are built
+      per data pass (1 = the round-1 one-pass-per-split behavior).
+    - hist_bf16: compute the histogram contraction with bf16 one-hot and
+      hi+lo-split bf16 weights (two MXU passes, ~f32-quality sums, roughly
+      2-4x faster than a true f32 contraction on TPU).
+    - max_bins is the STORED-GROUP histogram width (after EFB bundling);
+      feature_bins is the per-feature scan width for split finding
+      (<= max_bins; 0 means use max_bins). With bundling disabled the two
+      coincide and features == groups.
     """
     num_leaves: int
     max_bins: int
@@ -66,6 +84,15 @@ class GrowerConfig(NamedTuple):
     data_axis: Optional[str] = None
     feature_axis: Optional[str] = None
     num_feature_shards: int = 1
+    batch_k: int = 16
+    hist_bf16: bool = True
+    feature_bins: int = 0
+    # voting-parallel (PV-tree, voting_parallel_tree_learner.cpp): with
+    # data_axis set, exchange only the globally-elected top_k features'
+    # histogram slices instead of the full histogram tensor
+    voting: bool = False
+    top_k: int = 20
+    num_data_shards: int = 1
 
 
 class TreeGrowerState(NamedTuple):
@@ -86,8 +113,19 @@ class TreeGrowerState(NamedTuple):
     best_left_g: jnp.ndarray
     best_left_h: jnp.ndarray
     best_left_c: jnp.ndarray
-    # histogram pool [L, F, B, 3]
+    # histogram pool [L, F, B, 3]: the leaf's own histogram until its
+    # children are prefetched, then its LEFT child's histogram
     hist_pool: jnp.ndarray
+    # prefetch state: child_ready[l] = l's children histograms + best
+    # splits are computed; right_hist[l] holds l's RIGHT child histogram;
+    # lbest_*/rbest_* hold the children's cached best splits
+    child_ready: jnp.ndarray      # [L] bool
+    right_hist: jnp.ndarray       # [L, F, B, 3]
+    lbest: "ChildBest"
+    rbest: "ChildBest"
+    num_passes: jnp.ndarray       # scalar i32: data passes this tree
+    comm_elems: jnp.ndarray       # scalar f32: elements moved through
+                                  # cross-shard collectives this tree
     # tree node arrays [L-1]
     node_feature: jnp.ndarray
     node_threshold: jnp.ndarray
@@ -101,6 +139,73 @@ class TreeGrowerState(NamedTuple):
     num_leaves_used: jnp.ndarray  # scalar i32
 
 
+class ChildBest(NamedTuple):
+    """Cached best split of a not-yet-committed child, parent-indexed [L]."""
+    gain: jnp.ndarray
+    feature: jnp.ndarray
+    threshold: jnp.ndarray
+    default_left: jnp.ndarray
+    is_cat: jnp.ndarray
+    left_g: jnp.ndarray
+    left_h: jnp.ndarray
+    left_c: jnp.ndarray
+
+    @classmethod
+    def zeros(cls, L):
+        return cls(
+            gain=jnp.full(L, -jnp.inf, jnp.float32),
+            feature=jnp.zeros(L, jnp.int32),
+            threshold=jnp.zeros(L, jnp.int32),
+            default_left=jnp.zeros(L, bool),
+            is_cat=jnp.zeros(L, bool),
+            left_g=jnp.zeros(L, jnp.float32),
+            left_h=jnp.zeros(L, jnp.float32),
+            left_c=jnp.zeros(L, jnp.float32),
+        )
+
+    def set_at(self, idx, vals):
+        gain, feat, thr, dl, cat, lg, lh, lc = vals
+        return ChildBest(
+            gain=self.gain.at[idx].set(gain, mode="drop"),
+            feature=self.feature.at[idx].set(feat, mode="drop"),
+            threshold=self.threshold.at[idx].set(thr, mode="drop"),
+            default_left=self.default_left.at[idx].set(dl, mode="drop"),
+            is_cat=self.is_cat.at[idx].set(cat, mode="drop"),
+            left_g=self.left_g.at[idx].set(lg, mode="drop"),
+            left_h=self.left_h.at[idx].set(lh, mode="drop"),
+            left_c=self.left_c.at[idx].set(lc, mode="drop"),
+        )
+
+    def get(self, idx):
+        return (self.gain[idx], self.feature[idx], self.threshold[idx],
+                self.default_left[idx], self.is_cat[idx],
+                self.left_g[idx], self.left_h[idx], self.left_c[idx])
+
+
+def _extract_feature_hist(group_hist, sum_g, sum_h, count, fmeta, cfg):
+    """Per-feature histograms [F, Bf, 3] out of the stored-group histogram
+    [G, Bg, 3] (EFB layout, efb.py): feature f's bins live at
+    group_hist[group[f], offset[f] : offset[f] + num_bin[f]]. For bundled
+    features the default-bin slot holds no rows — its mass is leaf totals
+    minus the rest (the reference's FixHistogram, dataset.cpp:747-767)."""
+    g_, bg, _ = group_hist.shape
+    bf = cfg.feature_bins or cfg.max_bins
+    flat = group_hist.reshape(g_ * bg, 3)
+    bins = jnp.arange(bf, dtype=jnp.int32)[None, :]              # [1,Bf]
+    idx = fmeta["group"][:, None] * bg + fmeta["offset"][:, None] + bins
+    valid = bins < fmeta["num_bin"][:, None]
+    fh = flat[jnp.clip(idx, 0, g_ * bg - 1)]                     # [F,Bf,3]
+    fh = jnp.where(valid[:, :, None], fh, 0.0)
+    # FixHistogram for bundled features
+    at_default = (bins == fmeta["default_bin"][:, None]) & \
+        fmeta["is_bundled"][:, None]
+    totals = jnp.stack([jnp.broadcast_to(sum_g, at_default.shape[:1]),
+                        jnp.broadcast_to(sum_h, at_default.shape[:1]),
+                        jnp.broadcast_to(count, at_default.shape[:1])], -1)
+    rest = totals[:, None, :] - fh.sum(axis=1, keepdims=True)
+    return jnp.where(at_default[:, :, None], rest, fh)
+
+
 def _leaf_best_split(hist, sum_g, sum_h, count, depth, feature_mask, fmeta, cfg):
     """Best (gain, feature, ...) for one leaf from its (local) histogram.
 
@@ -110,6 +215,7 @@ def _leaf_best_split(hist, sum_g, sum_h, count, depth, feature_mask, fmeta, cfg)
     feature parallelism the argmax covers only this shard's features and is
     then combined across shards by an allreduce-argmax (the reference's
     SyncUpGlobalBestSplit, parallel_tree_learner.h:184-207)."""
+    hist = _extract_feature_hist(hist, sum_g, sum_h, count, fmeta, cfg)
     res = split_ops.find_best_splits(
         hist, sum_g, sum_h, count,
         fmeta["num_bin"], fmeta["missing_type"], fmeta["default_bin"],
@@ -165,66 +271,217 @@ def _set_leaf_best(state: TreeGrowerState, leaf, vals) -> TreeGrowerState:
     )
 
 
+def _row_feature_bins(binned, fmeta, feat):
+    """Per-row FEATURE-space bin of each row's (per-row) feature `feat`,
+    decoded from the stored group columns (EFB layout, efb.py): inside the
+    feature's slice the group bin is offset+bin; anywhere else the row is
+    at the feature's default bin."""
+    grp = fmeta["group"][feat]
+    gcol = jnp.take_along_axis(binned, grp[:, None], axis=1)[:, 0].astype(jnp.int32)
+    off = fmeta["offset"][feat]
+    nb = fmeta["num_bin"][feat]
+    in_slice = (gcol >= off) & (gcol < off + nb)
+    decoded = jnp.where(in_slice, gcol - off, fmeta["default_bin"][feat])
+    return jnp.where(fmeta["is_bundled"][feat], decoded, gcol)
+
+
+def _voting_children_best(hists_local, sum_g, sum_h, count, depth,
+                          feature_mask, fmeta, cfg):
+    """Voting-parallel best splits for a batch of C children
+    (reference: VotingParallelTreeLearner::FindBestSplitsFromHistograms +
+    GlobalVoting + CopyLocalHistogram, voting_parallel_tree_learner
+    .cpp:260-430). hists_local are LOCAL (un-reduced) group histograms
+    [C, G, B, 3]; sum_g/h/count are GLOBAL child aggregates [C].
+
+    Per child: (1) scan LOCAL histograms with constraints relaxed by
+    1/num_machines (cpp:55-56), (2) submit the local top_k features'
+    count-weighted gains, (3) elect the global top_k features by pmax'd
+    weighted gain — replicated, no tie ambiguity, (4) psum ONLY the
+    elected features' group-histogram slices, (5) full-precision scan of
+    the elected features with global sums. Communication per child is
+    O(top_k * B) instead of O(G * B)."""
+    ax = cfg.data_axis
+    m = cfg.num_data_shards
+    c = hists_local.shape[0]
+    bf = cfg.feature_bins or cfg.max_bins
+    bg = hists_local.shape[2]
+
+    # (1) local scans, relaxed constraints
+    ltot = hists_local[:, 0].sum(axis=1)                     # [C, 3]
+
+    def local_scan(h, lt):
+        fh = _extract_feature_hist(h, lt[0], lt[1], lt[2], fmeta, cfg)
+        res = split_ops.find_best_splits(
+            fh, lt[0], lt[1] + 2e-15, lt[2],
+            fmeta["num_bin"], fmeta["missing_type"], fmeta["default_bin"],
+            fmeta["is_categorical"],
+            lambda_l1=cfg.lambda_l1, lambda_l2=cfg.lambda_l2,
+            min_gain_to_split=cfg.min_gain_to_split,
+            min_data_in_leaf=max(1, cfg.min_data_in_leaf // m),
+            min_sum_hessian_in_leaf=cfg.min_sum_hessian_in_leaf / m)
+        return res.gain
+
+    gains_local = jax.vmap(local_scan)(hists_local, ltot)    # [C, F]
+    gains_local = jnp.where(feature_mask[None, :], gains_local, -jnp.inf)
+
+    # (2) local vote: only the local top_k features are submitted, with
+    # gains weighted by the local/mean data share (GlobalVoting weighting,
+    # cpp:171-180)
+    kth = jax.lax.top_k(gains_local, min(cfg.top_k, gains_local.shape[1]))[0][:, -1]
+    mean_cnt = jnp.maximum(count / m, 1.0)                   # [C] global/m
+    weight = ltot[:, 2] / mean_cnt
+    submitted = jnp.where(gains_local >= kth[:, None],
+                          gains_local * weight[:, None], -jnp.inf)
+
+    # (3) global election (allgather of LightSplitInfos -> pmax here)
+    global_gain = jax.lax.pmax(submitted, ax)                # [C, F]
+    k_sel = min(cfg.top_k, global_gain.shape[1])
+    _, elected = jax.lax.top_k(global_gain, k_sel)           # [C, k]
+
+    # (4) exchange only elected features' group slices
+    egrp = fmeta["group"][elected]                            # [C, k]
+    slices = jax.vmap(lambda h, g: h[g])(hists_local, egrp)   # [C, k, B, 3]
+    slices = jax.lax.psum(slices, ax)
+    comm = jnp.float32(c * k_sel * bg * 3 + c * gains_local.shape[1] )
+
+    # (5) global scan of elected features with global sums
+    eoff = fmeta["offset"][elected]
+    enb = fmeta["num_bin"][elected]
+    bins = jnp.arange(bf, dtype=jnp.int32)[None, None, :]
+    valid = bins < enb[:, :, None]
+    gidx = jnp.clip(eoff[:, :, None] + bins, 0, bg - 1)
+    efh = jnp.take_along_axis(
+        slices, gidx[:, :, :, None], axis=2)                  # [C, k, Bf, 3]
+    efh = jnp.where(valid[:, :, :, None], efh, 0.0)
+    at_default = (bins == fmeta["default_bin"][elected][:, :, None]) & \
+        fmeta["is_bundled"][elected][:, :, None]
+    totals = jnp.stack([sum_g, sum_h, count], -1)             # [C, 3]
+    rest = totals[:, None, None, :] - efh.sum(axis=2, keepdims=True)
+    efh = jnp.where(at_default[:, :, :, None], rest, efh)
+
+    def global_scan(fh_c, eidx, g, h, cnt, d):
+        res = split_ops.find_best_splits(
+            fh_c, g, h, cnt,
+            fmeta["num_bin"][eidx], fmeta["missing_type"][eidx],
+            fmeta["default_bin"][eidx], fmeta["is_categorical"][eidx],
+            lambda_l1=cfg.lambda_l1, lambda_l2=cfg.lambda_l2,
+            min_gain_to_split=cfg.min_gain_to_split,
+            min_data_in_leaf=cfg.min_data_in_leaf,
+            min_sum_hessian_in_leaf=cfg.min_sum_hessian_in_leaf)
+        gains = jnp.where(feature_mask[eidx], res.gain, -jnp.inf)
+        if cfg.max_depth > 0:
+            gains = jnp.where(d + 1 > cfg.max_depth, -jnp.inf, gains)
+        best = jnp.argmax(gains).astype(jnp.int32)
+        pick = lambda a: a[best]
+        return (pick(gains), eidx[best], pick(res.threshold),
+                pick(res.default_left), pick(res.is_categorical),
+                pick(res.left_sum_g), pick(res.left_sum_h),
+                pick(res.left_count))
+
+    vals = jax.vmap(global_scan)(efh, elected, sum_g, sum_h, count, depth)
+    return vals, comm
+
+
+def _route_go_left(state, binned, fmeta, rows_leaf):
+    """Per-row go-left decision under each row's leaf's CACHED best split
+    (replaces DataPartition::Split, data_partition.hpp:94-170). rows_leaf
+    is the per-row leaf whose split to apply (usually state.leaf_id)."""
+    lid = jnp.clip(rows_leaf, 0, state.best_feature.shape[0] - 1)
+    feat = state.best_feature[lid]                       # [N]
+    col = _row_feature_bins(binned, fmeta, feat)
+    thr = state.best_threshold[lid]
+    dl = state.best_default_left[lid]
+    cat = state.best_is_cat[lid]
+    missing = fmeta["missing_type"][feat]
+    nan_bin = fmeta["num_bin"][feat] - 1
+    dbin = fmeta["default_bin"][feat]
+    is_missing = (((missing == MISSING_NAN) & (col == nan_bin))
+                  | ((missing == MISSING_ZERO) & (col == dbin)))
+    numeric_left = jnp.where(is_missing, dl, col <= thr)
+    return jnp.where(cat, col == thr, numeric_left)
+
+
 @functools.partial(jax.jit, static_argnames=("cfg",))
 def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
               row_weight: jnp.ndarray, feature_mask: jnp.ndarray,
               fmeta_num_bin: jnp.ndarray, fmeta_missing: jnp.ndarray,
               fmeta_default_bin: jnp.ndarray, fmeta_is_cat: jnp.ndarray,
+              fmeta_group: jnp.ndarray, fmeta_offset: jnp.ndarray,
+              fmeta_is_bundled: jnp.ndarray,
               cfg: GrowerConfig):
     """Grow one leaf-wise tree.
 
     Args:
-      binned: [N, F] i32 bin indices, rows padded to a multiple of cfg.chunk
-        (padded rows must have row_weight 0).
+      binned: [N, G] integer STORED-GROUP bin indices (uint8 for <=256
+        bins; G <= F after EFB bundling, efb.py), rows padded to a
+        multiple of cfg.chunk (padded rows must have row_weight 0).
       grad/hess: [N] f32 gradients/hessians (GOSS amplification pre-applied
         via row_weight).
       row_weight: [N] f32 bagging weight (0 = excluded, GOSS weights > 0).
       feature_mask: [F] bool per-tree feature_fraction sample.
-    Returns: (DeviceTree fields without real thresholds, leaf_id) — the host
-      wraps them and converts bin thresholds to raw-space values.
+      fmeta_*: per-LOGICAL-feature metadata (Dataset.feature_meta_arrays).
+    Returns: TreeGrowerState — the host wraps the node arrays and converts
+      bin thresholds to raw-space values.
     """
-    n, f = binned.shape
+    n, g_cols = binned.shape
     L = cfg.num_leaves
     B = cfg.max_bins
+    K = max(1, min(cfg.batch_k, L))
     fmeta = {"num_bin": fmeta_num_bin, "missing_type": fmeta_missing,
-             "default_bin": fmeta_default_bin, "is_categorical": fmeta_is_cat}
+             "default_bin": fmeta_default_bin, "is_categorical": fmeta_is_cat,
+             "group": fmeta_group, "offset": fmeta_offset,
+             "is_bundled": fmeta_is_bundled}
+    f = fmeta_num_bin.shape[0]
 
     # feature parallelism: this shard builds histograms/splits only for its
     # contiguous feature block; routing still uses the full (replicated)
     # matrix (feature_parallel_tree_learner.cpp:31-69 — data replicated,
-    # features partitioned per machine)
+    # features partitioned per machine). Requires features == groups (the
+    # GBDT layer disables EFB bundling for the feature-parallel learner).
     if cfg.feature_axis is not None:
         fl = f // cfg.num_feature_shards
         fstart = jax.lax.axis_index(cfg.feature_axis) * fl
         local_binned = jax.lax.dynamic_slice_in_dim(binned, fstart, fl, axis=1)
         local_fmeta = {k: jax.lax.dynamic_slice_in_dim(v, fstart, fl)
                        for k, v in fmeta.items()}
+        # rebase group indices into the local block
+        local_fmeta["group"] = local_fmeta["group"] - fstart
         local_fmask = jax.lax.dynamic_slice_in_dim(feature_mask, fstart, fl)
     else:
-        fl = f
+        fl = g_cols
         local_binned, local_fmeta, local_fmask = binned, fmeta, feature_mask
 
-    def build_hist(w3):
-        """Local histogram + data-axis reduction (the ReduceScatter seam,
-        data_parallel_tree_learner.cpp:148-163 — XLA picks the schedule)."""
-        h = hist_ops.leaf_histogram(local_binned, w3, B, cfg.chunk)
-        if cfg.data_axis is not None:
+    voting = cfg.voting and cfg.data_axis is not None
+
+    def reduce_hist(h):
+        """Data-axis reduction seam (the ReduceScatter of
+        data_parallel_tree_learner.cpp:148-163 — XLA picks the schedule).
+        Voting mode keeps histograms LOCAL; only elected slices travel."""
+        if cfg.data_axis is not None and not voting:
             h = jax.lax.psum(h, cfg.data_axis)
         return h
+
+    w3 = jnp.stack([grad * row_weight, hess * row_weight,
+                    (row_weight > 0).astype(jnp.float32)], axis=-1)
 
     # all rows start in the root; excluded (bagged-out / padded) rows carry
     # row_weight 0 so they route through splits but contribute nothing
     leaf_id = jnp.zeros(n, jnp.int32)
 
     # --- root (BeforeTrain: serial_tree_learner.cpp:234-323) ------------
-    w3 = jnp.stack([grad * row_weight, hess * row_weight,
-                    (row_weight > 0).astype(jnp.float32)], axis=-1)
-    root_hist = build_hist(w3)
+    root_hist = reduce_hist(
+        hist_ops.leaf_histogram(local_binned, w3, B, cfg.chunk,
+                                bf16=cfg.hist_bf16))
     # global leaf sums: the reference Allreduces (cnt, sum_g, sum_h)
     # (data_parallel_tree_learner.cpp:117-145); summing any feature's bins
     # of the already-reduced histogram gives the same totals
     root_tot = root_hist[0].sum(axis=0)
+    if voting:
+        root_tot = jax.lax.psum(root_tot, cfg.data_axis)
     root_g, root_h, root_c = root_tot[0], root_tot[1], root_tot[2]
+    root_comm = jnp.float32(0.0)
+    if cfg.data_axis is not None:
+        root_comm = jnp.float32(3.0 if voting else fl * B * 3)
 
     neg_inf = jnp.float32(-jnp.inf)
     state = TreeGrowerState(
@@ -245,6 +502,12 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         best_left_h=jnp.zeros(L, jnp.float32),
         best_left_c=jnp.zeros(L, jnp.float32),
         hist_pool=jnp.zeros((L, fl, B, 3), jnp.float32).at[0].set(root_hist),
+        child_ready=jnp.zeros(L, bool),
+        right_hist=jnp.zeros((L, fl, B, 3), jnp.float32),
+        lbest=ChildBest.zeros(L),
+        rbest=ChildBest.zeros(L),
+        num_passes=jnp.int32(1),
+        comm_elems=root_comm,
         node_feature=jnp.zeros(L - 1, jnp.int32),
         node_threshold=jnp.zeros(L - 1, jnp.int32),
         node_default_left=jnp.zeros(L - 1, bool),
@@ -256,17 +519,95 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         node_count=jnp.zeros(L - 1, jnp.float32),
         num_leaves_used=jnp.int32(1),
     )
-    state = _set_leaf_best(state, 0, _leaf_best_split(
-        root_hist, root_g, root_h, root_c, jnp.int32(0), local_fmask,
-        local_fmeta, cfg))
+    if voting:
+        root_vals, comm1 = _voting_children_best(
+            root_hist[None], root_g[None], root_h[None], root_c[None],
+            jnp.zeros(1, jnp.int32), local_fmask, local_fmeta, cfg)
+        state = state._replace(comm_elems=state.comm_elems + comm1)
+        state = _set_leaf_best(state, 0, tuple(v[0] for v in root_vals))
+    else:
+        state = _set_leaf_best(state, 0, _leaf_best_split(
+            root_hist, root_g, root_h, root_c, jnp.int32(0), local_fmask,
+            local_fmeta, cfg))
+
+    def prefetch(state: TreeGrowerState) -> TreeGrowerState:
+        """One batched data pass: build the smaller-child histograms of the
+        top-K pending leaves (positive cached gain, children not ready),
+        derive both children's histograms and best splits, cache them
+        parent-indexed. Exactly the work the sequential grower would do at
+        each of those leaves' commits — done K at a time."""
+        pending = (state.best_gain > 0.0) & ~state.child_ready
+        cand = jnp.where(pending, state.best_gain, -jnp.inf)
+        top_gain, top_idx = jax.lax.top_k(cand, K)
+        sel = jnp.where(jnp.isfinite(top_gain), top_idx, jnp.int32(L))  # L = drop
+
+        # rows in the smaller child of their leaf's cached split
+        go_left = _route_go_left(state, binned, fmeta, state.leaf_id)
+        lc = state.best_left_c
+        smaller_is_left = lc <= (state.count - lc)          # [L]
+        sil_row = smaller_is_left[jnp.clip(state.leaf_id, 0, L - 1)]
+        in_smaller = go_left == sil_row
+
+        hists = reduce_hist(hist_ops.batched_leaf_histogram(
+            local_binned, w3, state.leaf_id, in_smaller, sel, B, cfg.chunk,
+            bf16=cfg.hist_bf16))                             # [K, fl, B, 3]
+
+        parent_hist = state.hist_pool[jnp.clip(sel, 0, L - 1)]
+        other = parent_hist - hists
+        sil_k = smaller_is_left[jnp.clip(sel, 0, L - 1)]
+        left_h_ = jnp.where(sil_k[:, None, None, None], hists, other)
+        right_h_ = jnp.where(sil_k[:, None, None, None], other, hists)
+
+        # children aggregates from the cached split stats
+        pg = state.sum_g[jnp.clip(sel, 0, L - 1)]
+        ph = state.sum_h[jnp.clip(sel, 0, L - 1)]
+        pc = state.count[jnp.clip(sel, 0, L - 1)]
+        lg = state.best_left_g[jnp.clip(sel, 0, L - 1)]
+        lh = state.best_left_h[jnp.clip(sel, 0, L - 1)]
+        lcc = state.best_left_c[jnp.clip(sel, 0, L - 1)]
+        cdepth = state.leaf_depth[jnp.clip(sel, 0, L - 1)] + 1
+
+        comm = jnp.float32(0.0)
+        if voting:
+            both = jnp.concatenate([left_h_, right_h_], axis=0)   # [2K,...]
+            vals2, comm = _voting_children_best(
+                both, jnp.concatenate([lg, pg - lg]),
+                jnp.concatenate([lh, ph - lh]),
+                jnp.concatenate([lcc, pc - lcc]),
+                jnp.concatenate([cdepth, cdepth]),
+                local_fmask, local_fmeta, cfg)
+            lvals = tuple(v[:K] for v in vals2)
+            rvals = tuple(v[K:] for v in vals2)
+        else:
+            if cfg.data_axis is not None:
+                comm = jnp.float32(K * fl * B * 3)
+            split_fn = jax.vmap(
+                lambda h, g, hh, c, d: _leaf_best_split(
+                    h, g, hh, c, d, local_fmask, local_fmeta, cfg))
+            lvals = split_fn(left_h_, lg, lh, lcc, cdepth)
+            rvals = split_fn(right_h_, pg - lg, ph - lh, pc - lcc, cdepth)
+
+        return state._replace(
+            hist_pool=state.hist_pool.at[sel].set(left_h_, mode="drop"),
+            right_hist=state.right_hist.at[sel].set(right_h_, mode="drop"),
+            lbest=state.lbest.set_at(sel, lvals),
+            rbest=state.rbest.set_at(sel, rvals),
+            child_ready=state.child_ready.at[sel].set(True, mode="drop"),
+            num_passes=state.num_passes + 1,
+            comm_elems=state.comm_elems + comm,
+        )
 
     # --- split loop (Train: serial_tree_learner.cpp:152-205) ------------
     def body(i, state: TreeGrowerState) -> TreeGrowerState:
         best_leaf = jnp.argmax(state.best_gain).astype(jnp.int32)
         should_split = state.best_gain[best_leaf] > 0.0
 
+        state = jax.lax.cond(
+            should_split & ~state.child_ready[best_leaf],
+            prefetch, lambda s: s, state)
+
         def do_split(state: TreeGrowerState) -> TreeGrowerState:
-            l = best_leaf
+            l = jnp.argmax(state.best_gain).astype(jnp.int32)
             new_leaf = i + 1
             feat = state.best_feature[l]
             thr = state.best_threshold[l]
@@ -276,29 +617,14 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             pg, ph, pc = state.sum_g[l], state.sum_h[l], state.count[l]
             rg, rh, rc = pg - lg, ph - lh, pc - lc
 
-            # route rows (replaces DataPartition::Split, data_partition.hpp:94)
-            col = jax.lax.dynamic_index_in_dim(binned, feat, axis=1, keepdims=False)
-            missing = fmeta["missing_type"][feat]
-            nan_bin = fmeta["num_bin"][feat] - 1
-            dbin = fmeta["default_bin"][feat]
-            from ..binning import MISSING_NAN, MISSING_ZERO
-            is_missing = (((missing == MISSING_NAN) & (col == nan_bin))
-                          | ((missing == MISSING_ZERO) & (col == dbin)))
-            numeric_left = jnp.where(is_missing, dl, col <= thr)
-            go_left = jnp.where(cat, col == thr, numeric_left)
+            # route rows of l (right side moves to the new slot)
+            go_left = _route_go_left(state, binned, fmeta, state.leaf_id)
             in_leaf = state.leaf_id == l
             leaf_id = jnp.where(in_leaf & ~go_left, new_leaf, state.leaf_id)
 
-            # smaller-child histogram + subtraction
-            smaller_is_left = lc <= rc
-            smaller_leaf = jnp.where(smaller_is_left, l, new_leaf)
-            w3s = hist_ops.leaf_weights(grad, hess, leaf_id, smaller_leaf, row_weight)
-            small_hist = build_hist(w3s)
-            parent_hist = state.hist_pool[l]
-            large_hist = parent_hist - small_hist
-            left_hist = jnp.where(smaller_is_left, small_hist, large_hist)
-            right_hist = jnp.where(smaller_is_left, large_hist, small_hist)
-            hist_pool = state.hist_pool.at[l].set(left_hist).at[new_leaf].set(right_hist)
+            # children histograms were prefetched: left is in hist_pool[l],
+            # right moves into the new slot
+            hist_pool = state.hist_pool.at[new_leaf].set(state.right_hist[l])
 
             # tree bookkeeping (Tree::Split, tree.cpp:50-69)
             parent_node = state.leaf_parent[l]
@@ -326,6 +652,8 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                                            .at[new_leaf].set(depth_l + 1),
                 leaf_parent=state.leaf_parent.at[l].set(i).at[new_leaf].set(i),
                 hist_pool=hist_pool,
+                child_ready=state.child_ready.at[l].set(False)
+                                             .at[new_leaf].set(False),
                 node_feature=state.node_feature.at[i].set(feat),
                 node_threshold=state.node_threshold.at[i].set(thr),
                 node_default_left=state.node_default_left.at[i].set(dl),
@@ -338,25 +666,29 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                 node_count=state.node_count.at[i].set(pc),
                 num_leaves_used=state.num_leaves_used + 1,
             )
-            # refresh best splits for the two children
-            state = _set_leaf_best(state, l, _leaf_best_split(
-                left_hist, lg, lh, lc, depth_l + 1, local_fmask,
-                local_fmeta, cfg))
-            state = _set_leaf_best(state, new_leaf, _leaf_best_split(
-                right_hist, rg, rh, rc, depth_l + 1, local_fmask,
-                local_fmeta, cfg))
+            # install the prefetched children best splits
+            state = _set_leaf_best(state, l, state.lbest.get(l))
+            state = _set_leaf_best(state, new_leaf, state.rbest.get(l))
             return state
 
         return jax.lax.cond(should_split, do_split, lambda s: s, state)
 
     state = jax.lax.fori_loop(0, L - 1, body, state)
+    if voting:
+        # histogram pools are shard-LOCAL in voting mode; zero them so the
+        # returned state is replicated (they are pure scratch by now)
+        state = state._replace(hist_pool=jnp.zeros_like(state.hist_pool),
+                               right_hist=jnp.zeros_like(state.right_hist))
     return state
+
+
+FMETA_KEYS = ("num_bin", "missing_type", "default_bin", "is_categorical",
+              "group", "offset", "is_bundled")
 
 
 def make_grower(cfg: GrowerConfig):
     """Convenience closure binding the static config."""
     def run(binned, grad, hess, row_weight, feature_mask, fmeta):
         return grow_tree(binned, grad, hess, row_weight, feature_mask,
-                         fmeta["num_bin"], fmeta["missing_type"],
-                         fmeta["default_bin"], fmeta["is_categorical"], cfg)
+                         *[fmeta[k] for k in FMETA_KEYS], cfg)
     return run

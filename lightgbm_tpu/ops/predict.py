@@ -33,11 +33,36 @@ class DeviceTree(NamedTuple):
     node_missing: jnp.ndarray      # [M] i32 missing type of the node's feature
     node_nan_bin: jnp.ndarray      # [M] i32 (num_bin-1 of the feature)
     node_default_bin: jnp.ndarray  # [M] i32
+    # EFB locators (efb.py): stored column + bin offset of the feature
+    node_group: jnp.ndarray        # [M] i32
+    node_offset: jnp.ndarray       # [M] i32
+    node_bundled: jnp.ndarray      # [M] bool
+    node_num_bin: jnp.ndarray      # [M] i32
     leaf_value: jnp.ndarray        # [L] f32
     split_gain: jnp.ndarray        # [M] f32
     internal_value: jnp.ndarray    # [M] f32
     internal_count: jnp.ndarray    # [M] f32
     leaf_count: jnp.ndarray        # [L] f32
+    # categorical bitsets (tree.h:355-359): a cat node's threshold_real /
+    # threshold_bin hold its cat_idx; membership is bit `value` of words
+    # [cat_boundaries[idx], cat_boundaries[idx+1]) (raw space) and the
+    # _inner variants (bin space)
+    cat_boundaries: jnp.ndarray        # [C+1] i32
+    cat_bitset: jnp.ndarray            # [W] u32 raw-value bitset words
+    cat_boundaries_inner: jnp.ndarray  # [C+1] i32
+    cat_bitset_inner: jnp.ndarray      # [W'] u32 bin-space bitset words
+
+
+def _in_bitset(boundaries, bitset, cat_idx, value):
+    """Vectorized Common::FindInBitset over per-node bitset slices."""
+    idx = jnp.maximum(cat_idx, 0)
+    lo = boundaries[idx]
+    nwords = boundaries[idx + 1] - lo
+    word_i = value // 32
+    valid = (value >= 0) & (word_i < nwords)
+    word = bitset[jnp.clip(lo + word_i, 0, bitset.shape[0] - 1)]
+    bit = (word >> (value % 32).astype(jnp.uint32)) & jnp.uint32(1)
+    return valid & (bit == 1)
 
 
 def _decide_binned(tree: DeviceTree, node: jnp.ndarray, bins: jnp.ndarray):
@@ -47,7 +72,8 @@ def _decide_binned(tree: DeviceTree, node: jnp.ndarray, bins: jnp.ndarray):
                   | ((missing == MISSING_ZERO) & (bins == tree.node_default_bin[node])))
     numeric_left = jnp.where(is_missing, tree.default_left[node],
                              bins <= tree.threshold_bin[node])
-    cat_left = bins == tree.threshold_bin[node]
+    cat_left = _in_bitset(tree.cat_boundaries_inner, tree.cat_bitset_inner,
+                          tree.threshold_bin[node], bins)
     return jnp.where(tree.is_categorical[node], cat_left, numeric_left)
 
 
@@ -63,8 +89,17 @@ def predict_leaf_binned(tree: DeviceTree, binned: jnp.ndarray) -> jnp.ndarray:
     def body(node):
         active = node >= 0
         nd = jnp.maximum(node, 0)
-        feat = tree.split_feature[nd]
-        bins = jnp.take_along_axis(binned, feat[:, None], axis=1)[:, 0]
+        grp = tree.node_group[nd]
+        gbins = jnp.take_along_axis(binned, grp[:, None], axis=1)[:, 0]
+        gbins = gbins.astype(jnp.int32)
+        # decode the feature-space bin out of the stored group column
+        off = tree.node_offset[nd]
+        nb = tree.node_num_bin[nd]
+        in_slice = (gbins >= off) & (gbins < off + nb)
+        bins = jnp.where(tree.node_bundled[nd],
+                         jnp.where(in_slice, gbins - off,
+                                   tree.node_default_bin[nd]),
+                         gbins)
         go_left = _decide_binned(tree, nd, bins)
         nxt = jnp.where(go_left, tree.left_child[nd], tree.right_child[nd])
         return jnp.where(active, nxt, node)
@@ -83,7 +118,10 @@ def _decide_raw(tree: DeviceTree, node: jnp.ndarray, fval: jnp.ndarray):
     fval_safe = jnp.where(is_nan, 0.0, fval)
     numeric_left = jnp.where(is_missing, tree.default_left[node],
                              fval_safe <= tree.threshold_real[node])
-    cat_left = (~is_nan) & (jnp.floor(fval_safe) == tree.threshold_real[node])
+    cat_left = (~is_nan) & _in_bitset(
+        tree.cat_boundaries, tree.cat_bitset,
+        tree.threshold_real[node].astype(jnp.int32),
+        jnp.floor(fval_safe).astype(jnp.int32))
     return jnp.where(tree.is_categorical[node], cat_left, numeric_left)
 
 
@@ -126,11 +164,15 @@ def stack_trees(trees) -> DeviceTree:
     import numpy as np
     max_m = max(max(t.num_leaves - 1, 1) for t in trees)
     max_l = max(t.num_leaves for t in trees)
+    max_cat = max(t.num_cat for t in trees)
+    max_w = max(max(len(t.cat_threshold), 1) for t in trees)
+    max_wi = max(max(len(t.cat_threshold_inner), 1) for t in trees)
+    fmax = np.finfo(np.float32).max
 
     def pad(get, size, dtype, fill=0):
         out = np.full((len(trees), size), fill, dtype)
         for i, t in enumerate(trees):
-            arr = get(t)
+            arr = np.asarray(get(t))
             out[i, :len(arr)] = arr
         return jnp.asarray(out)
 
@@ -138,7 +180,8 @@ def stack_trees(trees) -> DeviceTree:
         num_leaves=jnp.asarray([t.num_leaves for t in trees], jnp.int32),
         split_feature=pad(lambda t: t.split_feature_inner, max_m, np.int32),
         threshold_bin=pad(lambda t: t.threshold_in_bin, max_m, np.int32),
-        threshold_real=pad(lambda t: t.threshold, max_m, np.float32),
+        threshold_real=pad(lambda t: np.clip(t.threshold, -fmax, fmax),
+                           max_m, np.float32),
         default_left=pad(lambda t: [t.default_left_node(i) for i in
                                     range(max(t.num_leaves - 1, 0))], max_m, bool),
         is_categorical=pad(lambda t: [t.is_categorical_node(i) for i in
@@ -148,11 +191,31 @@ def stack_trees(trees) -> DeviceTree:
         node_missing=pad(lambda t: t.node_missing, max_m, np.int32),
         node_nan_bin=pad(lambda t: t.node_nan_bin, max_m, np.int32),
         node_default_bin=pad(lambda t: t.node_default_bin, max_m, np.int32),
+        node_group=pad(lambda t: t.node_group, max_m, np.int32),
+        node_offset=pad(lambda t: t.node_offset, max_m, np.int32),
+        node_bundled=pad(lambda t: t.node_bundled, max_m, bool),
+        node_num_bin=pad(lambda t: t.node_num_bin, max_m, np.int32),
         leaf_value=pad(lambda t: t.leaf_value, max_l, np.float32),
         split_gain=pad(lambda t: t.split_gain, max_m, np.float32),
         internal_value=pad(lambda t: t.internal_value, max_m, np.float32),
         internal_count=pad(lambda t: t.internal_count, max_m, np.float32),
         leaf_count=pad(lambda t: t.leaf_count, max_l, np.float32),
+        # pad boundaries with the last offset so out-of-range cat_idx
+        # slices are empty; bitset words pad with 0 (no membership)
+        cat_boundaries=pad(
+            lambda t: np.concatenate(
+                [t.cat_boundaries,
+                 np.full(max_cat + 2 - len(t.cat_boundaries),
+                         t.cat_boundaries[-1], np.int32)]),
+            max_cat + 2, np.int32),
+        cat_bitset=pad(lambda t: t.cat_threshold, max_w, np.uint32),
+        cat_boundaries_inner=pad(
+            lambda t: np.concatenate(
+                [t.cat_boundaries_inner,
+                 np.full(max_cat + 2 - len(t.cat_boundaries_inner),
+                         t.cat_boundaries_inner[-1], np.int32)]),
+            max_cat + 2, np.int32),
+        cat_bitset_inner=pad(lambda t: t.cat_threshold_inner, max_wi, np.uint32),
     )
 
 

@@ -68,16 +68,15 @@ class DataParallelGrower:
         # replicated (identical on all shards by construction)
         state_spec = self._state_specs()
         run = jax.shard_map(
-            lambda b, g, h, w, fm, nb, mt, db, ic:
-                grow_tree(b, g, h, w, fm, nb, mt, db, ic, cfg),
+            lambda b, g, h, w, fm, *meta: grow_tree(b, g, h, w, fm, *meta, cfg),
             mesh=self.mesh,
-            in_specs=(P(ax, None), P(ax), P(ax), P(ax), P(None),
-                      P(None), P(None), P(None), P(None)),
+            in_specs=(P(ax, None), P(ax), P(ax), P(ax), P(None))
+                     + (P(None),) * 7,
             out_specs=state_spec,
             check_vma=False)
+        from ..learner.grow import FMETA_KEYS
         return run(binned, grad, hess, row_weight, feature_mask,
-                   fmeta["num_bin"], fmeta["missing_type"],
-                   fmeta["default_bin"], fmeta["is_categorical"])
+                   *[fmeta[k] for k in FMETA_KEYS])
 
     def _state_specs(self):
         from ..learner.grow import TreeGrowerState
@@ -114,6 +113,10 @@ class FeatureParallelGrower:
         fmeta["missing_type"] = np.concatenate([fmeta["missing_type"], np.zeros(extra, np.int32)])
         fmeta["default_bin"] = np.concatenate([fmeta["default_bin"], np.zeros(extra, np.int32)])
         fmeta["is_categorical"] = np.concatenate([fmeta["is_categorical"], np.zeros(extra, bool)])
+        fmeta["group"] = np.concatenate(
+            [fmeta["group"], np.arange(f, fpad, dtype=np.int32)])
+        fmeta["offset"] = np.concatenate([fmeta["offset"], np.zeros(extra, np.int32)])
+        fmeta["is_bundled"] = np.concatenate([fmeta["is_bundled"], np.zeros(extra, bool)])
         return binned, fmeta
 
     def __call__(self, binned, grad, hess, row_weight, feature_mask, fmeta):
@@ -121,28 +124,38 @@ class FeatureParallelGrower:
         ax = self.axis
         from ..learner.grow import TreeGrowerState
         fields = {name: P() for name in TreeGrowerState._fields}
-        fields["hist_pool"] = P(None, ax)  # [L, F/shards, B, 3] per shard
+        # the histogram pools are [L, F/shards, B, 3] per shard
+        fields["hist_pool"] = P(None, ax)
+        fields["right_hist"] = P(None, ax)
         state_spec = TreeGrowerState(**fields)
         run = jax.shard_map(
-            lambda b, g, h, w, fm, nb, mt, db, ic:
-                grow_tree(b, g, h, w, fm, nb, mt, db, ic, cfg),
+            lambda b, g, h, w, fm, *meta: grow_tree(b, g, h, w, fm, *meta, cfg),
             mesh=self.mesh,
-            in_specs=(P(None, None), P(None), P(None), P(None), P(None),
-                      P(None), P(None), P(None), P(None)),
+            in_specs=(P(None, None), P(None), P(None), P(None), P(None))
+                     + (P(None),) * 7,
             out_specs=state_spec,
             check_vma=False)
+        from ..learner.grow import FMETA_KEYS
         return run(binned, grad, hess, row_weight, feature_mask,
-                   fmeta["num_bin"], fmeta["missing_type"],
-                   fmeta["default_bin"], fmeta["is_categorical"])
+                   *[fmeta[k] for k in FMETA_KEYS])
 
 
 class VotingParallelGrower(DataParallelGrower):
     """PV-tree voting-parallel (reference: VotingParallelTreeLearner,
-    voting_parallel_tree_learner.cpp). Round-1 implementation note: the
-    communication-compression (top-k feature voting before the histogram
-    reduce) is expressed by the SAME psum seam — XLA fuses the reduction —
-    so this subclass currently shares the data-parallel path; the explicit
-    top-k gather/scatter optimization lands with the Pallas histogram
-    kernels. Semantics (global split choice) are identical to data-parallel
-    when top_k >= num_features."""
-    pass
+    voting_parallel_tree_learner.cpp:1-482): rows sharded like
+    data-parallel, but histograms stay shard-local; each shard submits its
+    top_k features by (relaxed-constraint) local gain, a pmax elects the
+    global top_k by count-weighted gain (GlobalVoting, cpp:165-194), and
+    only the elected features' histogram slices are psum'd
+    (CopyLocalHistogram + ReduceScatter, cpp:196-258). Cross-shard traffic
+    per batched pass is O(children * top_k * bins) instead of
+    O(groups * bins * children); `state.comm_elems` records the measured
+    volume. Split choice equals data-parallel when top_k >= num_features
+    (every feature elected -> full-precision scan of everything)."""
+
+    def __init__(self, mesh: Mesh, cfg: GrowerConfig, axis: str = "data",
+                 top_k: int = 20):
+        super().__init__(mesh, cfg, axis)
+        self.cfg = self.cfg._replace(
+            voting=True, top_k=max(1, top_k),
+            num_data_shards=self.nshards)

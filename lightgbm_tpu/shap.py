@@ -66,7 +66,11 @@ def _unwound_sum(m, ud, pi):
 def _decision(tree: Tree, node: int, row: np.ndarray) -> bool:
     fval = row[tree.split_feature[node]]
     if tree.is_categorical_node(node):
-        return (not np.isnan(fval)) and int(fval) == int(tree.threshold[node])
+        if np.isnan(fval):
+            return False
+        idx = int(tree.threshold[node])
+        lo, hi = tree.cat_boundaries[idx], tree.cat_boundaries[idx + 1]
+        return tree._in_bitset(tree.cat_threshold[lo:hi], int(fval))
     mt = tree.missing_type_node(node)
     is_missing = (mt == MISSING_NAN and np.isnan(fval)) or \
                  (mt == MISSING_ZERO and (np.isnan(fval) or abs(fval) <= 1e-35))

@@ -54,10 +54,71 @@ class Tree:
         self.internal_value = np.zeros(m, np.float64)
         self.internal_count = np.zeros(m, np.int64)
         self.shrinkage = 1.0
+        # categorical bitsets (reference: tree.h:355-359, tree.cpp:71-97):
+        # a categorical node stores a cat_idx in threshold=; the category
+        # set is bits [cat_boundaries[idx], cat_boundaries[idx+1]) words of
+        # cat_threshold (raw category values) / cat_threshold_inner (bins)
+        self.num_cat = 0
+        self.cat_boundaries = np.zeros(1, np.int32)        # word offsets
+        self.cat_threshold = np.zeros(0, np.uint32)        # raw-value bitset
+        self.cat_boundaries_inner = np.zeros(1, np.int32)
+        self.cat_threshold_inner = np.zeros(0, np.uint32)  # bin-space bitset
         # device-traversal metadata (not serialized; rebuilt on load)
         self.node_missing = np.zeros(m, np.int32)
         self.node_nan_bin = np.zeros(m, np.int32)
         self.node_default_bin = np.zeros(m, np.int32)
+        # EFB locators for binned traversal (efb.py): the stored column and
+        # bin offset of each node's feature
+        self.node_group = np.zeros(m, np.int32)
+        self.node_offset = np.zeros(m, np.int32)
+        self.node_bundled = np.zeros(m, bool)
+        self.node_num_bin = np.zeros(m, np.int32)
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _bitset(values) -> np.ndarray:
+        """Reference: Common::ConstructBitset (common.h)."""
+        values = [int(v) for v in values if v >= 0]
+        nwords = (max(values) // 32 + 1) if values else 1
+        words = np.zeros(nwords, np.uint32)
+        for v in values:
+            words[v // 32] |= np.uint32(1) << np.uint32(v % 32)
+        return words
+
+    @staticmethod
+    def _in_bitset(words: np.ndarray, val: int) -> bool:
+        """Reference: Common::FindInBitset."""
+        if val < 0:
+            return False
+        w = val // 32
+        if w >= len(words):
+            return False
+        return bool((int(words[w]) >> (val % 32)) & 1)
+
+    def _push_cat(self, raw_values, bin_values) -> int:
+        """Append one categorical node's bitsets; returns its cat_idx."""
+        idx = self.num_cat
+        raw_words = self._bitset(raw_values)
+        bin_words = self._bitset(bin_values)
+        self.cat_threshold = np.concatenate([self.cat_threshold, raw_words])
+        self.cat_boundaries = np.append(
+            self.cat_boundaries, self.cat_boundaries[-1] + len(raw_words)
+        ).astype(np.int32)
+        self.cat_threshold_inner = np.concatenate(
+            [self.cat_threshold_inner, bin_words])
+        self.cat_boundaries_inner = np.append(
+            self.cat_boundaries_inner,
+            self.cat_boundaries_inner[-1] + len(bin_words)).astype(np.int32)
+        self.num_cat += 1
+        return idx
+
+    def cat_values(self, node: int) -> list:
+        """Raw category values going left at a categorical node."""
+        idx = int(self.threshold[node])
+        lo, hi = self.cat_boundaries[idx], self.cat_boundaries[idx + 1]
+        words = self.cat_threshold[lo:hi]
+        return [w * 32 + b for w in range(len(words)) for b in range(32)
+                if (int(words[w]) >> b) & 1]
 
     # ------------------------------------------------------------------
     @classmethod
@@ -89,15 +150,26 @@ class Tree:
         t.internal_count = np.asarray(state.node_count)[:m].astype(np.int64)
         t.leaf_value = np.asarray(state.leaf_value)[:nl].astype(np.float64)
         t.leaf_count = np.asarray(state.count)[:nl].astype(np.int64)
+        fm = dataset.feature_meta_arrays()
         for i in range(m):
             mapper = dataset.feature_mapper(int(feat[i]))
             t.node_missing[i] = mapper.missing_type
             t.node_nan_bin[i] = mapper.num_bin - 1
             t.node_default_bin[i] = mapper.default_bin
+            t.node_group[i] = fm["group"][feat[i]]
+            t.node_offset[i] = fm["offset"][feat[i]]
+            t.node_bundled[i] = fm["is_bundled"][feat[i]]
+            t.node_num_bin[i] = mapper.num_bin
             dt = 0
             if cat[i]:
                 dt |= _CAT_MASK
-                t.threshold[i] = float(mapper.bin_to_value(int(thr[i])))
+                # one-vs-rest: the bin in thr goes left; serialize as a
+                # cat_idx into single-category bitsets (tree.cpp:71-97);
+                # threshold/threshold_in_bin both hold the cat_idx
+                raw_val = int(mapper.bin_to_value(int(thr[i])))
+                cat_idx = t._push_cat([raw_val], [int(thr[i])])
+                t.threshold[i] = float(cat_idx)
+                t.threshold_in_bin[i] = cat_idx
             else:
                 if dl[i]:
                     dt |= _DEFAULT_LEFT_MASK
@@ -117,6 +189,8 @@ class Tree:
         inner_of = {real: inner for inner, real
                     in enumerate(dataset.used_features)}
         m = self.num_leaves - 1
+        inner_sets = {}
+        fm = dataset.feature_meta_arrays()
         for i in range(m):
             real = int(self.split_feature[i])
             if real not in inner_of:
@@ -128,12 +202,31 @@ class Tree:
             self.node_missing[i] = mapper.missing_type
             self.node_nan_bin[i] = mapper.num_bin - 1
             self.node_default_bin[i] = mapper.default_bin
+            self.node_group[i] = fm["group"][inner]
+            self.node_offset[i] = fm["offset"][inner]
+            self.node_bundled[i] = fm["is_bundled"][inner]
+            self.node_num_bin[i] = mapper.num_bin
             if self.is_categorical_node(i):
-                self.threshold_in_bin[i] = mapper.categorical_2_bin.get(
-                    int(self.threshold[i]), mapper.num_bin - 1)
+                # rebuild this node's bin-space bitset from its raw one
+                idx = int(self.threshold[i])
+                bin_vals = [mapper.categorical_2_bin[c]
+                            for c in self.cat_values(i)
+                            if c in mapper.categorical_2_bin]
+                inner_sets[idx] = self._bitset(bin_vals)
+                self.threshold_in_bin[i] = idx
             else:
                 self.threshold_in_bin[i] = mapper.value_to_bin(
                     float(self.threshold[i]))
+        if self.num_cat > 0:
+            bounds = [0]
+            for idx in range(self.num_cat):
+                words = inner_sets.get(idx, np.zeros(1, np.uint32))
+                bounds.append(bounds[-1] + len(words))
+            self.cat_boundaries_inner = np.asarray(bounds, np.int32)
+            self.cat_threshold_inner = (
+                np.concatenate([inner_sets.get(i, np.zeros(1, np.uint32))
+                                for i in range(self.num_cat)])
+                if self.num_cat else np.zeros(0, np.uint32))
         self.has_bin_metadata = True
 
     # ------------------------------------------------------------------
@@ -166,11 +259,15 @@ class Tree:
         dl = np.asarray([self.default_left_node(i) for i in range(m)], bool)
         cat = np.asarray([self.is_categorical_node(i) for i in range(m)], bool)
         miss = np.asarray([self.missing_type_node(i) for i in range(m)], np.int32)
+        # clamp the reference's +-1e300 AvoidInf sentinels into f32 range
+        # (a f32 cast would overflow to inf with a RuntimeWarning)
+        fmax = float(np.finfo(np.float32).max)
+        thr32 = np.clip(self.threshold, -fmax, fmax)
         return DeviceTree(
             num_leaves=jnp.int32(self.num_leaves),
             split_feature=jnp.asarray(self.split_feature_inner),
             threshold_bin=jnp.asarray(self.threshold_in_bin),
-            threshold_real=jnp.asarray(self.threshold, jnp.float32),
+            threshold_real=jnp.asarray(thr32, jnp.float32),
             default_left=jnp.asarray(dl),
             is_categorical=jnp.asarray(cat),
             left_child=jnp.asarray(self.left_child),
@@ -178,11 +275,23 @@ class Tree:
             node_missing=jnp.asarray(miss),
             node_nan_bin=jnp.asarray(self.node_nan_bin),
             node_default_bin=jnp.asarray(self.node_default_bin),
+            node_group=jnp.asarray(self.node_group),
+            node_offset=jnp.asarray(self.node_offset),
+            node_bundled=jnp.asarray(self.node_bundled),
+            node_num_bin=jnp.asarray(self.node_num_bin),
             leaf_value=jnp.asarray(self.leaf_value, jnp.float32),
             split_gain=jnp.asarray(self.split_gain, jnp.float32),
             internal_value=jnp.asarray(self.internal_value, jnp.float32),
             internal_count=jnp.asarray(self.internal_count, jnp.float32),
             leaf_count=jnp.asarray(self.leaf_count, jnp.float32),
+            cat_boundaries=jnp.asarray(self.cat_boundaries, jnp.int32),
+            cat_bitset=jnp.asarray(
+                self.cat_threshold if len(self.cat_threshold)
+                else np.zeros(1, np.uint32)),
+            cat_boundaries_inner=jnp.asarray(self.cat_boundaries_inner, jnp.int32),
+            cat_bitset_inner=jnp.asarray(
+                self.cat_threshold_inner if len(self.cat_threshold_inner)
+                else np.zeros(1, np.uint32)),
         )
 
     def to_device_raw(self):
@@ -201,7 +310,10 @@ class Tree:
         while node >= 0:
             fval = row[self.split_feature[node]]
             if self.is_categorical_node(node):
-                go_left = (not np.isnan(fval)) and int(fval) == int(self.threshold[node])
+                idx = int(self.threshold[node])
+                lo, hi = self.cat_boundaries[idx], self.cat_boundaries[idx + 1]
+                go_left = (not np.isnan(fval)) and self._in_bitset(
+                    self.cat_threshold[lo:hi], int(fval))
             else:
                 mt = self.missing_type_node(node)
                 is_missing = (mt == MISSING_NAN and np.isnan(fval)) or \
@@ -219,7 +331,7 @@ class Tree:
         m = self.num_leaves - 1
         out = []
         out.append(f"num_leaves={self.num_leaves}")
-        out.append(f"num_cat=0")
+        out.append(f"num_cat={self.num_cat}")
         out.append("split_feature=" + " ".join(str(int(x)) for x in self.split_feature[:m]))
         out.append("split_gain=" + " ".join(repr(float(x)) for x in self.split_gain[:m]))
         out.append("threshold=" + " ".join(repr(float(x)) for x in self.threshold[:m]))
@@ -230,6 +342,11 @@ class Tree:
         out.append("leaf_count=" + " ".join(str(int(x)) for x in self.leaf_count[:self.num_leaves]))
         out.append("internal_value=" + " ".join(repr(float(x)) for x in self.internal_value[:m]))
         out.append("internal_count=" + " ".join(str(int(x)) for x in self.internal_count[:m]))
+        if self.num_cat > 0:
+            out.append("cat_boundaries=" + " ".join(
+                str(int(x)) for x in self.cat_boundaries[:self.num_cat + 1]))
+            out.append("cat_threshold=" + " ".join(
+                str(int(x)) for x in self.cat_threshold))
         out.append(f"shrinkage={self.shrinkage}")
         # extension over the reference format: bin-space metadata so loaded
         # models can still traverse binned matrices on device
@@ -237,6 +354,11 @@ class Tree:
         out.append("tpu_split_feature_inner=" + " ".join(str(int(x)) for x in self.split_feature_inner[:m]))
         out.append("tpu_nan_bin=" + " ".join(str(int(x)) for x in self.node_nan_bin[:m]))
         out.append("tpu_default_bin=" + " ".join(str(int(x)) for x in self.node_default_bin[:m]))
+        if self.num_cat > 0:
+            out.append("tpu_cat_boundaries_inner=" + " ".join(
+                str(int(x)) for x in self.cat_boundaries_inner[:self.num_cat + 1]))
+            out.append("tpu_cat_threshold_inner=" + " ".join(
+                str(int(x)) for x in self.cat_threshold_inner))
         return "\n".join(out) + "\n"
 
     @classmethod
@@ -275,6 +397,22 @@ class Tree:
             t.node_default_bin = arr("tpu_default_bin", np.int32, m)
             t.node_missing = np.asarray(
                 [t.missing_type_node(i) for i in range(m)], np.int32)
+            t.num_cat = int(kv.get("num_cat", 0))
+            if t.num_cat > 0:
+                t.cat_boundaries = arr("cat_boundaries", np.int32, t.num_cat + 1)
+                t.cat_threshold = np.asarray(
+                    [np.uint32(v) for v in kv.get("cat_threshold", "").split()],
+                    np.uint32)
+                inner = kv.get("tpu_cat_threshold_inner", "")
+                if inner:
+                    t.cat_boundaries_inner = arr(
+                        "tpu_cat_boundaries_inner", np.int32, t.num_cat + 1)
+                    t.cat_threshold_inner = np.asarray(
+                        [np.uint32(v) for v in inner.split()], np.uint32)
+                else:
+                    # reference text lacks bin-space bitsets; rebuilt on
+                    # demand by attach_bin_metadata
+                    t.has_bin_metadata = False
         t.leaf_value = arr("leaf_value", np.float64, nl)
         t.leaf_count = arr("leaf_count", np.int64, nl)
         t.shrinkage = float(kv.get("shrinkage", 1.0))
@@ -289,11 +427,15 @@ class Tree:
                 return {"leaf_index": int(leaf),
                         "leaf_value": float(self.leaf_value[leaf]),
                         "leaf_count": int(self.leaf_count[leaf])}
+            if self.is_categorical_node(idx):
+                thr = "||".join(str(c) for c in self.cat_values(idx))
+            else:
+                thr = float(self.threshold[idx])
             return {
                 "split_index": int(idx),
                 "split_feature": int(self.split_feature[idx]),
                 "split_gain": float(self.split_gain[idx]),
-                "threshold": float(self.threshold[idx]),
+                "threshold": thr,
                 "decision_type": "==" if self.is_categorical_node(idx) else "<=",
                 "default_left": self.default_left_node(idx),
                 "missing_type": ["None", "Zero", "NaN"][self.missing_type_node(idx)],

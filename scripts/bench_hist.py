@@ -1,0 +1,186 @@
+"""Microbenchmark of histogram-kernel formulations on the live accelerator.
+
+Explores the design space for the hottest op (SURVEY.md §7: segment
+histograms) before committing to one:
+  v0  current: per-leaf one-hot einsum, f32 HIGHEST      [round-1 shipped]
+  v1  per-leaf one-hot einsum, default precision
+  v2  per-leaf one-hot bf16 x (hi+lo) split weights
+  v3  per-leaf channel-separated VPU reduce
+  v4  K-leaf batched one-hot einsum (cfb,cls->lfbs) f32
+  v5  K-leaf batched bf16 x (hi+lo)
+  v6  segment-sum scatter over leaf*B+bin
+
+Prints ms/pass and effective rows/s for each; run on TPU:
+    python scripts/bench_hist.py
+"""
+from __future__ import annotations
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+N = 1 << 19          # 524288 rows
+F = 28
+B = 64
+K = 32               # batched leaves
+CHUNK = 1 << 15
+L = 255
+
+rng = np.random.RandomState(0)
+binned_np = rng.randint(0, B, size=(N, F)).astype(np.uint8)
+w_np = rng.randn(N, 3).astype(np.float32)
+w_np[:, 2] = 1.0
+leaf_np = rng.randint(0, L, size=N).astype(np.int32)
+batch_leaves_np = np.arange(K, dtype=np.int32)
+
+
+def timeit(fn, *args, iters=10):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters * 1e3  # ms
+
+
+def chunked(hist_chunk_fn, binned, w, init):
+    n_chunks = binned.shape[0] // CHUNK
+    bc = binned.reshape(n_chunks, CHUNK, F)
+    wc = w.reshape(n_chunks, CHUNK, -1)
+
+    def body(acc, xs):
+        b, ww = xs
+        return acc + hist_chunk_fn(b, ww), None
+
+    hist, _ = jax.lax.scan(body, init, (bc, wc))
+    return hist
+
+
+@jax.jit
+def v0_highest(binned, w):
+    def chunk_fn(b, ww):
+        oh = (b[:, :, None] == jnp.arange(B, dtype=b.dtype)[None, None, :])
+        return jnp.einsum("cfb,cs->fbs", oh.astype(jnp.float32), ww,
+                          preferred_element_type=jnp.float32,
+                          precision=jax.lax.Precision.HIGHEST)
+    return chunked(chunk_fn, binned, w, jnp.zeros((F, B, 3), jnp.float32))
+
+
+@jax.jit
+def v1_default(binned, w):
+    def chunk_fn(b, ww):
+        oh = (b[:, :, None] == jnp.arange(B, dtype=b.dtype)[None, None, :])
+        return jnp.einsum("cfb,cs->fbs", oh.astype(jnp.float32), ww,
+                          preferred_element_type=jnp.float32)
+    return chunked(chunk_fn, binned, w, jnp.zeros((F, B, 3), jnp.float32))
+
+
+def _hi_lo(w):
+    hi = w.astype(jnp.bfloat16)
+    lo = (w - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    return hi, lo
+
+
+@jax.jit
+def v2_bf16(binned, w):
+    def chunk_fn(b, ww):
+        oh = (b[:, :, None] == jnp.arange(B, dtype=b.dtype)[None, None, :]
+              ).astype(jnp.bfloat16)
+        hi, lo = _hi_lo(ww)
+        h = jnp.einsum("cfb,cs->fbs", oh, hi,
+                       preferred_element_type=jnp.float32)
+        h += jnp.einsum("cfb,cs->fbs", oh, lo,
+                        preferred_element_type=jnp.float32)
+        return h
+    return chunked(chunk_fn, binned, w, jnp.zeros((F, B, 3), jnp.float32))
+
+
+@jax.jit
+def v3_vpu(binned, w):
+    def chunk_fn(b, ww):
+        oh = (b[:, :, None] == jnp.arange(B, dtype=b.dtype)[None, None, :])
+        ohf = oh.astype(jnp.float32)
+        outs = [(ohf * ww[:, None, None, s]).sum(0) for s in range(3)]
+        return jnp.stack(outs, axis=-1)
+    return chunked(chunk_fn, binned, w, jnp.zeros((F, B, 3), jnp.float32))
+
+
+@jax.jit
+def v4_batched_f32(binned, w, leaf_id, batch_leaves):
+    wl = jnp.concatenate([w, leaf_id[:, None].astype(jnp.float32)], axis=1)
+
+    def chunk_fn(b, wwl):
+        ww, lid = wwl[:, :3], wwl[:, 3].astype(jnp.int32)
+        lhot = (lid[:, None] == batch_leaves[None, :]).astype(jnp.float32)
+        u = (lhot[:, :, None] * ww[:, None, :]).reshape(-1, K * 3)
+        oh = (b[:, :, None] == jnp.arange(B, dtype=b.dtype)[None, None, :]
+              ).astype(jnp.float32)
+        h = jnp.einsum("cfb,cx->fbx", oh, u, preferred_element_type=jnp.float32)
+        return h
+    out = chunked(chunk_fn, binned, wl,
+                  jnp.zeros((F, B, K * 3), jnp.float32))
+    return out.reshape(F, B, K, 3).transpose(2, 0, 1, 3)
+
+
+@jax.jit
+def v5_batched_bf16(binned, w, leaf_id, batch_leaves):
+    wl = jnp.concatenate([w, leaf_id[:, None].astype(jnp.float32)], axis=1)
+
+    def chunk_fn(b, wwl):
+        ww, lid = wwl[:, :3], wwl[:, 3].astype(jnp.int32)
+        lhot = (lid[:, None] == batch_leaves[None, :]).astype(jnp.float32)
+        u = (lhot[:, :, None] * ww[:, None, :]).reshape(-1, K * 3)
+        hi, lo = _hi_lo(u)
+        oh = (b[:, :, None] == jnp.arange(B, dtype=b.dtype)[None, None, :]
+              ).astype(jnp.bfloat16)
+        h = jnp.einsum("cfb,cx->fbx", oh, hi, preferred_element_type=jnp.float32)
+        h += jnp.einsum("cfb,cx->fbx", oh, lo, preferred_element_type=jnp.float32)
+        return h
+    out = chunked(chunk_fn, binned, wl,
+                  jnp.zeros((F, B, K * 3), jnp.float32))
+    return out.reshape(F, B, K, 3).transpose(2, 0, 1, 3)
+
+
+@jax.jit
+def v6_segment(binned, w, leaf_id):
+    # scatter-add over (leaf, bin) per feature: the "true" segment-sum
+    idx = leaf_id[:, None].astype(jnp.int32) * B + binned.astype(jnp.int32)
+
+    def per_feature(f_idx):
+        return jax.ops.segment_sum(w, idx[:, f_idx], num_segments=L * B)
+    out = jax.vmap(per_feature)(jnp.arange(F))
+    return out.reshape(F, L, B, 3)
+
+
+def main():
+    print("devices:", jax.devices())
+    binned = jnp.asarray(binned_np)
+    binned_i32 = jnp.asarray(binned_np.astype(np.int32))
+    w = jnp.asarray(w_np)
+    leaf = jnp.asarray(leaf_np)
+    bl = jnp.asarray(batch_leaves_np)
+
+    rows = N / 1e6
+    for name, fn, args in [
+        ("v0_highest_u8", v0_highest, (binned, w)),
+        ("v0_highest_i32", v0_highest, (binned_i32, w)),
+        ("v1_default_u8", v1_default, (binned, w)),
+        ("v2_bf16_u8", v2_bf16, (binned, w)),
+        ("v3_vpu_u8", v3_vpu, (binned, w)),
+        ("v4_batched_f32_u8(K=32)", v4_batched_f32, (binned, w, leaf, bl)),
+        ("v5_batched_bf16_u8(K=32)", v5_batched_bf16, (binned, w, leaf, bl)),
+        ("v6_segment_u8", v6_segment, (binned, w, leaf)),
+    ]:
+        try:
+            ms = timeit(fn, *args)
+            print(f"{name:28s} {ms:9.3f} ms/pass   {rows/ (ms/1e3):8.1f} Mrow/s")
+        except Exception as e:  # noqa: BLE001
+            print(f"{name:28s} FAILED: {type(e).__name__}: {e}")
+
+
+if __name__ == "__main__":
+    main()

@@ -34,13 +34,13 @@ def _cfg(ds, chunk=256, **kw):
 
 
 def _serial_state(ds, grad, hess):
+    from lightgbm_tpu.learner.grow import FMETA_KEYS
     fm = {k: jnp.asarray(v) for k, v in ds.feature_meta_arrays().items()}
     cfg = _cfg(ds)
     return grow_tree(jnp.asarray(ds.binned), jnp.asarray(grad),
                      jnp.asarray(hess), jnp.ones(ds.num_data, jnp.float32),
                      jnp.ones(ds.num_features, bool),
-                     fm["num_bin"], fm["missing_type"], fm["default_bin"],
-                     fm["is_categorical"], cfg)
+                     *[fm[k] for k in FMETA_KEYS], cfg)
 
 
 def test_data_parallel_matches_serial(problem):

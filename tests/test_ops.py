@@ -28,9 +28,38 @@ def test_histogram_matches_numpy():
     g = rng.randn(n).astype(np.float32)
     h = rng.rand(n).astype(np.float32)
     w = np.stack([g, h, np.ones(n, np.float32)], axis=1)
-    hist = np.asarray(leaf_histogram(jnp.asarray(binned), jnp.asarray(w), B, chunk=128))
     ref = _np_histogram(binned, w, B)
+    # f32 path: exact to f32 round-off
+    hist = np.asarray(leaf_histogram(jnp.asarray(binned), jnp.asarray(w), B,
+                                     chunk=128, bf16=False))
     np.testing.assert_allclose(hist, ref, rtol=1e-5, atol=1e-5)
+    # bf16 hi+lo path: ~2^-16 relative per product, f32 accumulation;
+    # counts must stay EXACT (0/1 values are bf16-representable)
+    hist16 = np.asarray(leaf_histogram(jnp.asarray(binned), jnp.asarray(w), B,
+                                       chunk=128, bf16=True))
+    np.testing.assert_allclose(hist16, ref, rtol=2e-4, atol=2e-4)
+    np.testing.assert_array_equal(hist16[:, :, 2], ref[:, :, 2])
+
+
+def test_batched_histogram_matches_per_leaf():
+    from lightgbm_tpu.ops.histogram import batched_leaf_histogram
+    rng = np.random.RandomState(3)
+    n, f, B, K = 512, 4, 16, 4
+    binned = rng.randint(0, B, size=(n, f)).astype(np.uint8)
+    g = rng.randn(n).astype(np.float32)
+    h = rng.rand(n).astype(np.float32)
+    w = np.stack([g, h, np.ones(n, np.float32)], axis=1)
+    leaf_id = rng.randint(0, 6, size=n).astype(np.int32)
+    row_mask = rng.rand(n) < 0.7
+    leaves = np.asarray([0, 2, 5, 99], np.int32)  # 99 = padding (no rows)
+    out = np.asarray(batched_leaf_histogram(
+        jnp.asarray(binned), jnp.asarray(w), jnp.asarray(leaf_id),
+        jnp.asarray(row_mask), jnp.asarray(leaves), B, chunk=128, bf16=False))
+    for k, leaf in enumerate(leaves):
+        sel = (leaf_id == leaf) & row_mask
+        ref = _np_histogram(binned[sel], w[sel], B) if sel.any() else \
+            np.zeros((f, B, 3))
+        np.testing.assert_allclose(out[k], ref, rtol=1e-5, atol=1e-5)
 
 
 def test_histogram_masked_leaf():
@@ -43,7 +72,8 @@ def test_histogram_masked_leaf():
     bag = np.ones(n, np.float32)
     w = np.asarray(leaf_weights(jnp.asarray(g), jnp.asarray(h),
                                 jnp.asarray(leaf_id), 1, jnp.asarray(bag)))
-    hist = np.asarray(leaf_histogram(jnp.asarray(binned), jnp.asarray(w), B, chunk=256))
+    hist = np.asarray(leaf_histogram(jnp.asarray(binned), jnp.asarray(w), B,
+                                     chunk=256, bf16=False))
     sel = leaf_id == 1
     ref = _np_histogram(binned[sel], np.stack(
         [g[sel], h[sel], np.ones(sel.sum(), np.float32)], axis=1), B)
