@@ -15,19 +15,30 @@ pool, and host loops. Here the entire `num_leaves-1` split loop is ONE
   by an argmax over features, replacing per-feature OMP loops
   (serial_tree_learner.cpp:451-516).
 
-Histogram batching (the round-2 redesign): the reference touches only the
+Histogram batching (the round-3 redesign): the reference touches only the
 smaller child's rows per split (dense_bin.hpp:66-133), which a fixed-shape
 masked reduction cannot — every pass costs O(N). Instead of one pass per
 split, we exploit that a leaf's cached best split fully determines its
 children's row sets BEFORE the leaf is committed: a single batched pass
-builds the smaller-child histograms of up to `batch_k` pending leaves at
-once (one-hot-over-bins x leaf-member-weights einsum whose MXU N-dimension
-is batch_k*3 instead of 3), the larger children come from the parent-minus-
-smaller subtraction trick (serial_tree_learner.cpp:482-487), and their best
-splits are cached parent-indexed. The sequential best-first commit loop is
-unchanged — trees are IDENTICAL to the one-pass-per-split grower — but a
-data pass now happens only when the argmax leaf's children were not yet
-prefetched: ~(num_leaves/batch_k) passes per tree on bushy trees.
+builds BOTH children's histograms of up to `batch_k` pending leaves at
+once (one-hot-over-bins x member-weights einsum whose MXU output dimension
+is 2*batch_k*3 channels instead of 3 — utilization-bound, so both children
+of K leaves cost one pass), and their best splits are cached
+parent-indexed. The sequential best-first commit loop is unchanged —
+trees are IDENTICAL to the one-pass-per-split grower — but a data pass
+happens only when the argmax leaf's children were not yet prefetched.
+
+Two structural rules keep the 254-iteration commit loop off the TPU's
+slow paths (profiled in round 2: per-iteration [N]-gathers and `lax.cond`
+copies of pooled histograms dominated everything):
+- NO histogram state survives across loop iterations. Children histograms
+  are consumed into cached best splits inside the prefetch; the
+  parent-minus-smaller subtraction (serial_tree_learner.cpp:482-487) is
+  replaced by building both children directly in the same pass.
+- NO per-row gathers inside the commit path. The prefetch stores each
+  routed row's go-left bit (`split_bit[N]`) using per-leaf DYNAMIC SLICES
+  of the transposed bin matrix (contiguous [G, N] rows) + scalar
+  broadcasts; a commit is then a pure elementwise where() on leaf_id.
 
 `lax.cond` keeps iterations after growth stops (all gains <= 0) nearly
 free. One compile per (N, F, B, L, hyperparam) signature, reused across
@@ -97,6 +108,11 @@ class GrowerConfig(NamedTuple):
 
 class TreeGrowerState(NamedTuple):
     leaf_id: jnp.ndarray          # [N] i32 (-1 = padded/inactive row)
+    # split_bit[r]: go-left decision of row r under its CURRENT leaf's
+    # cached best split; written by the prefetch routing pass, consumed
+    # (elementwise, no gathers) by the commit. Valid whenever the row's
+    # leaf has child_ready set — exactly when a commit can touch it.
+    split_bit: jnp.ndarray        # [N] bool
     # per-leaf aggregates [L]
     sum_g: jnp.ndarray
     sum_h: jnp.ndarray
@@ -113,14 +129,9 @@ class TreeGrowerState(NamedTuple):
     best_left_g: jnp.ndarray
     best_left_h: jnp.ndarray
     best_left_c: jnp.ndarray
-    # histogram pool [L, F, B, 3]: the leaf's own histogram until its
-    # children are prefetched, then its LEFT child's histogram
-    hist_pool: jnp.ndarray
-    # prefetch state: child_ready[l] = l's children histograms + best
-    # splits are computed; right_hist[l] holds l's RIGHT child histogram;
-    # lbest_*/rbest_* hold the children's cached best splits
+    # prefetch state: child_ready[l] = l's children best splits are
+    # cached (lbest/rbest, parent-indexed) and l's rows' split_bit is set
     child_ready: jnp.ndarray      # [L] bool
-    right_hist: jnp.ndarray       # [L, F, B, 3]
     lbest: "ChildBest"
     rbest: "ChildBest"
     num_passes: jnp.ndarray       # scalar i32: data passes this tree
@@ -271,18 +282,46 @@ def _set_leaf_best(state: TreeGrowerState, leaf, vals) -> TreeGrowerState:
     )
 
 
-def _row_feature_bins(binned, fmeta, feat):
-    """Per-row FEATURE-space bin of each row's (per-row) feature `feat`,
-    decoded from the stored group columns (EFB layout, efb.py): inside the
-    feature's slice the group bin is offset+bin; anywhere else the row is
-    at the feature's default bin."""
-    grp = fmeta["group"][feat]
-    gcol = jnp.take_along_axis(binned, grp[:, None], axis=1)[:, 0].astype(jnp.int32)
-    off = fmeta["offset"][feat]
-    nb = fmeta["num_bin"][feat]
-    in_slice = (gcol >= off) & (gcol < off + nb)
-    decoded = jnp.where(in_slice, gcol - off, fmeta["default_bin"][feat])
-    return jnp.where(fmeta["is_bundled"][feat], decoded, gcol)
+def _route_leaves(state, binned_T, fmeta, sel, L):
+    """Go-left bits for the rows of the selected leaves, under each leaf's
+    CACHED best split (replaces DataPartition::Split,
+    data_partition.hpp:94-170, and the round-2 per-row gather routing).
+
+    For each selected leaf the split descriptor is a handful of SCALARS
+    (dynamic-indexed from the [L] caches) and the feature's bin column is
+    ONE contiguous dynamic slice of the transposed bin matrix [G, N] —
+    no [N]-indexed gathers anywhere, so nothing routes through the TPU's
+    serialized gather path. Returns state.split_bit updated for rows whose
+    leaf is in `sel`."""
+    split_bit = state.split_bit
+    n = binned_T.shape[1]
+    for k in range(sel.shape[0]):
+        sel_k = sel[k]
+        l = jnp.clip(sel_k, 0, L - 1)
+        feat = state.best_feature[l]
+        grp = fmeta["group"][feat]
+        off = fmeta["offset"][feat]
+        nb = fmeta["num_bin"][feat]
+        dbin = fmeta["default_bin"][feat]
+        missing = fmeta["missing_type"][feat]
+        col = jax.lax.dynamic_slice(
+            binned_T, (grp, 0), (1, n))[0].astype(jnp.int32)
+        # EFB decode (efb.py): inside the feature's bundle slice the group
+        # bin is offset+bin; anywhere else the row sits at the default bin
+        in_slice = (col >= off) & (col < off + nb)
+        decoded = jnp.where(in_slice, col - off, dbin)
+        col = jnp.where(fmeta["is_bundled"][feat], decoded, col)
+        thr = state.best_threshold[l]
+        dl = state.best_default_left[l]
+        cat = state.best_is_cat[l]
+        nan_bin = nb - 1
+        is_missing = (((missing == MISSING_NAN) & (col == nan_bin))
+                      | ((missing == MISSING_ZERO) & (col == dbin)))
+        go_left = jnp.where(cat, col == thr,
+                            jnp.where(is_missing, dl, col <= thr))
+        in_k = state.leaf_id == sel_k
+        split_bit = jnp.where(in_k, go_left, split_bit)
+    return split_bit
 
 
 def _voting_children_best(hists_local, sum_g, sum_h, count, depth,
@@ -382,25 +421,6 @@ def _voting_children_best(hists_local, sum_g, sum_h, count, depth,
     return vals, comm
 
 
-def _route_go_left(state, binned, fmeta, rows_leaf):
-    """Per-row go-left decision under each row's leaf's CACHED best split
-    (replaces DataPartition::Split, data_partition.hpp:94-170). rows_leaf
-    is the per-row leaf whose split to apply (usually state.leaf_id)."""
-    lid = jnp.clip(rows_leaf, 0, state.best_feature.shape[0] - 1)
-    feat = state.best_feature[lid]                       # [N]
-    col = _row_feature_bins(binned, fmeta, feat)
-    thr = state.best_threshold[lid]
-    dl = state.best_default_left[lid]
-    cat = state.best_is_cat[lid]
-    missing = fmeta["missing_type"][feat]
-    nan_bin = fmeta["num_bin"][feat] - 1
-    dbin = fmeta["default_bin"][feat]
-    is_missing = (((missing == MISSING_NAN) & (col == nan_bin))
-                  | ((missing == MISSING_ZERO) & (col == dbin)))
-    numeric_left = jnp.where(is_missing, dl, col <= thr)
-    return jnp.where(cat, col == thr, numeric_left)
-
-
 @functools.partial(jax.jit, static_argnames=("cfg",))
 def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
               row_weight: jnp.ndarray, feature_mask: jnp.ndarray,
@@ -464,6 +484,11 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     w3 = jnp.stack([grad * row_weight, hess * row_weight,
                     (row_weight > 0).astype(jnp.float32)], axis=-1)
 
+    # transposed bin matrix for the routing step: row g is the contiguous
+    # bin column of stored group g (loop-invariant — XLA hoists it out of
+    # the commit loop)
+    binned_T = binned.T
+
     # all rows start in the root; excluded (bagged-out / padded) rows carry
     # row_weight 0 so they route through splits but contribute nothing
     leaf_id = jnp.zeros(n, jnp.int32)
@@ -486,6 +511,7 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     neg_inf = jnp.float32(-jnp.inf)
     state = TreeGrowerState(
         leaf_id=leaf_id,
+        split_bit=jnp.zeros(n, bool),
         sum_g=jnp.zeros(L, jnp.float32).at[0].set(root_g),
         sum_h=jnp.zeros(L, jnp.float32).at[0].set(root_h),
         count=jnp.zeros(L, jnp.float32).at[0].set(root_c),
@@ -501,9 +527,7 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         best_left_g=jnp.zeros(L, jnp.float32),
         best_left_h=jnp.zeros(L, jnp.float32),
         best_left_c=jnp.zeros(L, jnp.float32),
-        hist_pool=jnp.zeros((L, fl, B, 3), jnp.float32).at[0].set(root_hist),
         child_ready=jnp.zeros(L, bool),
-        right_hist=jnp.zeros((L, fl, B, 3), jnp.float32),
         lbest=ChildBest.zeros(L),
         rbest=ChildBest.zeros(L),
         num_passes=jnp.int32(1),
@@ -531,9 +555,10 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             local_fmeta, cfg))
 
     def prefetch(state: TreeGrowerState) -> TreeGrowerState:
-        """One batched data pass: build the smaller-child histograms of the
-        top-K pending leaves (positive cached gain, children not ready),
-        derive both children's histograms and best splits, cache them
+        """One batched data pass: route the rows of the top-K pending
+        leaves (positive cached gain, children not ready) under their
+        cached splits, build BOTH children's histograms for all K leaves
+        in one contraction, scan their best splits, cache them
         parent-indexed. Exactly the work the sequential grower would do at
         each of those leaves' commits — done K at a time."""
         pending = (state.best_gain > 0.0) & ~state.child_ready
@@ -541,22 +566,13 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         top_gain, top_idx = jax.lax.top_k(cand, K)
         sel = jnp.where(jnp.isfinite(top_gain), top_idx, jnp.int32(L))  # L = drop
 
-        # rows in the smaller child of their leaf's cached split
-        go_left = _route_go_left(state, binned, fmeta, state.leaf_id)
-        lc = state.best_left_c
-        smaller_is_left = lc <= (state.count - lc)          # [L]
-        sil_row = smaller_is_left[jnp.clip(state.leaf_id, 0, L - 1)]
-        in_smaller = go_left == sil_row
+        # per-row go-left bits under the selected leaves' cached splits
+        # (full/global feature space — routing never shards features)
+        split_bit = _route_leaves(state, binned_T, fmeta, sel, L)
 
-        hists = reduce_hist(hist_ops.batched_leaf_histogram(
-            local_binned, w3, state.leaf_id, in_smaller, sel, B, cfg.chunk,
-            bf16=cfg.hist_bf16))                             # [K, fl, B, 3]
-
-        parent_hist = state.hist_pool[jnp.clip(sel, 0, L - 1)]
-        other = parent_hist - hists
-        sil_k = smaller_is_left[jnp.clip(sel, 0, L - 1)]
-        left_h_ = jnp.where(sil_k[:, None, None, None], hists, other)
-        right_h_ = jnp.where(sil_k[:, None, None, None], other, hists)
+        hists = reduce_hist(hist_ops.batched_children_histogram(
+            local_binned, w3, state.leaf_id, split_bit, sel, B, cfg.chunk,
+            bf16=cfg.hist_bf16))                             # [2K, fl, B, 3]
 
         # children aggregates from the cached split stats
         pg = state.sum_g[jnp.clip(sel, 0, L - 1)]
@@ -566,30 +582,28 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         lh = state.best_left_h[jnp.clip(sel, 0, L - 1)]
         lcc = state.best_left_c[jnp.clip(sel, 0, L - 1)]
         cdepth = state.leaf_depth[jnp.clip(sel, 0, L - 1)] + 1
+        all_g = jnp.concatenate([lg, pg - lg])
+        all_h = jnp.concatenate([lh, ph - lh])
+        all_c = jnp.concatenate([lcc, pc - lcc])
+        all_d = jnp.concatenate([cdepth, cdepth])
 
         comm = jnp.float32(0.0)
         if voting:
-            both = jnp.concatenate([left_h_, right_h_], axis=0)   # [2K,...]
             vals2, comm = _voting_children_best(
-                both, jnp.concatenate([lg, pg - lg]),
-                jnp.concatenate([lh, ph - lh]),
-                jnp.concatenate([lcc, pc - lcc]),
-                jnp.concatenate([cdepth, cdepth]),
+                hists, all_g, all_h, all_c, all_d,
                 local_fmask, local_fmeta, cfg)
-            lvals = tuple(v[:K] for v in vals2)
-            rvals = tuple(v[K:] for v in vals2)
         else:
             if cfg.data_axis is not None:
-                comm = jnp.float32(K * fl * B * 3)
+                comm = jnp.float32(2 * K * fl * B * 3)
             split_fn = jax.vmap(
                 lambda h, g, hh, c, d: _leaf_best_split(
                     h, g, hh, c, d, local_fmask, local_fmeta, cfg))
-            lvals = split_fn(left_h_, lg, lh, lcc, cdepth)
-            rvals = split_fn(right_h_, pg - lg, ph - lh, pc - lcc, cdepth)
+            vals2 = split_fn(hists, all_g, all_h, all_c, all_d)
+        lvals = tuple(v[:K] for v in vals2)
+        rvals = tuple(v[K:] for v in vals2)
 
         return state._replace(
-            hist_pool=state.hist_pool.at[sel].set(left_h_, mode="drop"),
-            right_hist=state.right_hist.at[sel].set(right_h_, mode="drop"),
+            split_bit=split_bit,
             lbest=state.lbest.set_at(sel, lvals),
             rbest=state.rbest.set_at(sel, rvals),
             child_ready=state.child_ready.at[sel].set(True, mode="drop"),
@@ -617,14 +631,11 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             pg, ph, pc = state.sum_g[l], state.sum_h[l], state.count[l]
             rg, rh, rc = pg - lg, ph - lh, pc - lc
 
-            # route rows of l (right side moves to the new slot)
-            go_left = _route_go_left(state, binned, fmeta, state.leaf_id)
+            # route rows of l via the prefetched split bits (right side
+            # moves to the new slot) — pure elementwise, no gathers
             in_leaf = state.leaf_id == l
-            leaf_id = jnp.where(in_leaf & ~go_left, new_leaf, state.leaf_id)
-
-            # children histograms were prefetched: left is in hist_pool[l],
-            # right moves into the new slot
-            hist_pool = state.hist_pool.at[new_leaf].set(state.right_hist[l])
+            leaf_id = jnp.where(in_leaf & ~state.split_bit, new_leaf,
+                                state.leaf_id)
 
             # tree bookkeeping (Tree::Split, tree.cpp:50-69)
             parent_node = state.leaf_parent[l]
@@ -651,7 +662,6 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                 leaf_depth=state.leaf_depth.at[l].set(depth_l + 1)
                                            .at[new_leaf].set(depth_l + 1),
                 leaf_parent=state.leaf_parent.at[l].set(i).at[new_leaf].set(i),
-                hist_pool=hist_pool,
                 child_ready=state.child_ready.at[l].set(False)
                                              .at[new_leaf].set(False),
                 node_feature=state.node_feature.at[i].set(feat),
@@ -674,11 +684,6 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         return jax.lax.cond(should_split, do_split, lambda s: s, state)
 
     state = jax.lax.fori_loop(0, L - 1, body, state)
-    if voting:
-        # histogram pools are shard-LOCAL in voting mode; zero them so the
-        # returned state is replicated (they are pure scratch by now)
-        state = state._replace(hist_pool=jnp.zeros_like(state.hist_pool),
-                               right_hist=jnp.zeros_like(state.right_hist))
     return state
 
 

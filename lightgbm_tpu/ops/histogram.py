@@ -23,11 +23,12 @@ Two performance levers over the naive contraction:
   bf16 halves (two accumulating passes). The one-hot and the count channel
   are exactly representable in bf16; grad/hess recover ~16 mantissa bits,
   within f32 round-off of the true sum, at 2-4x the f32 contraction rate.
-- `batched_leaf_histogram` builds K leaves' histograms in ONE pass by
-  widening the contraction's output dimension from 3 channels to K*3 —
-  the MXU is utilization-bound on that dimension, so K histograms cost
-  barely more than one. This is what makes per-level/priority-batched
-  growth (learner/grow.py) O(N * passes/K) instead of O(N * leaves).
+- `batched_children_histogram` builds BOTH children's histograms of K
+  splitting leaves in ONE pass by widening the contraction's output
+  dimension from 3 channels to 2*K*3 — the MXU is utilization-bound on
+  that dimension, so 2K histograms cost barely more than one. This is
+  what makes priority-batched growth (learner/grow.py) O(N * passes/K)
+  instead of O(N * leaves), with no parent histogram state at all.
 """
 from __future__ import annotations
 
@@ -107,25 +108,22 @@ def leaf_histogram(binned: jnp.ndarray, weights: jnp.ndarray,
 
 @functools.partial(jax.jit,
                    static_argnames=("num_bins", "chunk", "bf16"))
-def batched_leaf_histogram(binned: jnp.ndarray, weights: jnp.ndarray,
-                           leaf_id: jnp.ndarray, row_mask: jnp.ndarray,
-                           leaves: jnp.ndarray, num_bins: int,
-                           chunk: int = 16384,
-                           bf16: bool = True) -> jnp.ndarray:
-    """K leaves' histograms in one pass over the data.
+def batched_children_histogram(binned: jnp.ndarray, weights: jnp.ndarray,
+                               leaf_id: jnp.ndarray, split_bit: jnp.ndarray,
+                               leaves: jnp.ndarray, num_bins: int,
+                               chunk: int = 16384,
+                               bf16: bool = True) -> jnp.ndarray:
+    """BOTH children's histograms of K splitting leaves in one data pass.
 
-    hist[k, f, b, s] = sum_r 1[leaf_id[r] == leaves[k]] * row_mask[r]
-                             * 1[bin[r, f] == b] * weights[r, s]
-
-    Args:
-      binned:  [N, F] int bin indices.
-      weights: [N, 3] channel tensor as in leaf_histogram.
-      leaf_id: [N] i32 current leaf of each row.
-      row_mask: [N] bool additional row filter (e.g. "in the smaller child
-        of the leaf's cached split").
-      leaves:  [K] i32 leaf ids to build (out-of-range entries yield zero
-        histograms — use as padding).
-    Returns: [K, F, B, 3] float32.
+    split_bit[r] is the go-left decision of row r under ITS OWN leaf's
+    cached best split (computed by the grower's routing step). Output
+    [2K, F, B, 3]: slot k is the LEFT child of leaves[k], slot K+k the
+    RIGHT child. The contraction's output dim widens from 3 to 2K*3
+    channels — the MXU is utilization-bound there (2K*3 <= 128 for
+    K <= 21 costs the same as 3), so both children of K leaves cost one
+    pass, replacing the reference's smaller-child pass + parent-minus
+    subtraction (serial_tree_learner.cpp:349-363, 482-487) without
+    keeping any parent histogram state at all.
     """
     n, f = binned.shape
     if n % chunk != 0:
@@ -135,26 +133,26 @@ def batched_leaf_histogram(binned: jnp.ndarray, weights: jnp.ndarray,
     binned_c = binned.reshape(n_chunks, chunk, f)
     w_c = weights.reshape(n_chunks, chunk, 3)
     lid_c = leaf_id.reshape(n_chunks, chunk)
-    m_c = row_mask.reshape(n_chunks, chunk)
+    bit_c = split_bit.reshape(n_chunks, chunk)
 
-    def one(b_chunk, w_chunk, lid_chunk, m_chunk):
-        member = (lid_chunk[:, None] == leaves[None, :]) & m_chunk[:, None]
-        # u[c, k*3+s] = member[c,k] * w[c,s] — the widened output dim
-        u = (member[:, :, None].astype(jnp.float32)
-             * w_chunk[:, None, :]).reshape(chunk, k * 3)
-        out = _contract(_onehot(b_chunk, num_bins), u, bf16)   # [F,B,K*3]
-        return out
+    def one(b_chunk, w_chunk, lid_chunk, bit_chunk):
+        member = lid_chunk[:, None] == leaves[None, :]        # [C, K]
+        m2k = jnp.concatenate(
+            [member & bit_chunk[:, None], member & ~bit_chunk[:, None]],
+            axis=1)                                           # [C, 2K]
+        u = (m2k[:, :, None].astype(jnp.float32)
+             * w_chunk[:, None, :]).reshape(chunk, 2 * k * 3)
+        return _contract(_onehot(b_chunk, num_bins), u, bf16)  # [F,B,2K*3]
 
     if n_chunks == 1:
-        hist = one(binned_c[0], w_c[0], lid_c[0], m_c[0])
+        hist = one(binned_c[0], w_c[0], lid_c[0], bit_c[0])
     else:
         def body(acc, xs):
-            b_chunk, w_chunk, lid_chunk, m_chunk = xs
-            return acc + one(b_chunk, w_chunk, lid_chunk, m_chunk), None
+            return acc + one(*xs), None
 
-        init = jnp.zeros((f, num_bins, k * 3), dtype=jnp.float32)
-        hist, _ = jax.lax.scan(body, init, (binned_c, w_c, lid_c, m_c))
-    return hist.reshape(f, num_bins, k, 3).transpose(2, 0, 1, 3)
+        init = jnp.zeros((f, num_bins, 2 * k * 3), dtype=jnp.float32)
+        hist, _ = jax.lax.scan(body, init, (binned_c, w_c, lid_c, bit_c))
+    return hist.reshape(f, num_bins, 2 * k, 3).transpose(2, 0, 1, 3)
 
 
 def leaf_weights(grad: jnp.ndarray, hess: jnp.ndarray, leaf_id: jnp.ndarray,

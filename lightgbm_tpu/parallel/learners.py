@@ -83,6 +83,7 @@ class DataParallelGrower:
         ax = self.axis
         fields = {name: P() for name in TreeGrowerState._fields}
         fields["leaf_id"] = P(ax)
+        fields["split_bit"] = P(ax)
         return TreeGrowerState(**fields)
 
 
@@ -124,9 +125,6 @@ class FeatureParallelGrower:
         ax = self.axis
         from ..learner.grow import TreeGrowerState
         fields = {name: P() for name in TreeGrowerState._fields}
-        # the histogram pools are [L, F/shards, B, 3] per shard
-        fields["hist_pool"] = P(None, ax)
-        fields["right_hist"] = P(None, ax)
         state_spec = TreeGrowerState(**fields)
         run = jax.shard_map(
             lambda b, g, h, w, fm, *meta: grow_tree(b, g, h, w, fm, *meta, cfg),

@@ -41,8 +41,8 @@ def test_histogram_matches_numpy():
     np.testing.assert_array_equal(hist16[:, :, 2], ref[:, :, 2])
 
 
-def test_batched_histogram_matches_per_leaf():
-    from lightgbm_tpu.ops.histogram import batched_leaf_histogram
+def test_batched_children_histogram_matches_per_leaf():
+    from lightgbm_tpu.ops.histogram import batched_children_histogram
     rng = np.random.RandomState(3)
     n, f, B, K = 512, 4, 16, 4
     binned = rng.randint(0, B, size=(n, f)).astype(np.uint8)
@@ -50,16 +50,20 @@ def test_batched_histogram_matches_per_leaf():
     h = rng.rand(n).astype(np.float32)
     w = np.stack([g, h, np.ones(n, np.float32)], axis=1)
     leaf_id = rng.randint(0, 6, size=n).astype(np.int32)
-    row_mask = rng.rand(n) < 0.7
+    split_bit = rng.rand(n) < 0.7  # go-left decision per row
     leaves = np.asarray([0, 2, 5, 99], np.int32)  # 99 = padding (no rows)
-    out = np.asarray(batched_leaf_histogram(
+    out = np.asarray(batched_children_histogram(
         jnp.asarray(binned), jnp.asarray(w), jnp.asarray(leaf_id),
-        jnp.asarray(row_mask), jnp.asarray(leaves), B, chunk=128, bf16=False))
+        jnp.asarray(split_bit), jnp.asarray(leaves), B, chunk=128,
+        bf16=False))
+    assert out.shape == (2 * K, f, B, 3)
     for k, leaf in enumerate(leaves):
-        sel = (leaf_id == leaf) & row_mask
-        ref = _np_histogram(binned[sel], w[sel], B) if sel.any() else \
-            np.zeros((f, B, 3))
-        np.testing.assert_allclose(out[k], ref, rtol=1e-5, atol=1e-5)
+        left = (leaf_id == leaf) & split_bit
+        right = (leaf_id == leaf) & ~split_bit
+        for slot, sel in ((k, left), (K + k, right)):
+            ref = _np_histogram(binned[sel], w[sel], B) if sel.any() else \
+                np.zeros((f, B, 3))
+            np.testing.assert_allclose(out[slot], ref, rtol=1e-5, atol=1e-5)
 
 
 def test_histogram_masked_leaf():
