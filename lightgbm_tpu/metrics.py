@@ -234,6 +234,24 @@ class CrossEntropyLambdaMetric(Metric):
         return [(self.name[0], self._avg(loss))]
 
 
+def query_layout(qb: np.ndarray):
+    """(qid, pos) row layout for query-contiguous arrays: qid[r] = query of
+    row r, pos[r] = row r's offset inside its query. Tolerates zero-size
+    queries (np.repeat skips them)."""
+    sizes = np.diff(qb)
+    qid = np.repeat(np.arange(len(sizes)), sizes)
+    pos = np.arange(int(qb[-1])) - np.repeat(qb[:-1], sizes)
+    return qid, pos
+
+
+def segment_sum(arr: np.ndarray, qb: np.ndarray) -> np.ndarray:
+    """Per-query sums of a query-contiguous array via exclusive-cumsum
+    differences — unlike np.add.reduceat this is correct for zero-size
+    queries (their sum is 0) and for qb entries equal to len(arr)."""
+    csum = np.concatenate([[0], np.cumsum(arr, dtype=np.float64)])
+    return csum[qb[1:]] - csum[qb[:-1]]
+
+
 def _dcg_at_k(labels: np.ndarray, order: np.ndarray, k: int,
               label_gain: np.ndarray) -> float:
     top = order[:k]
@@ -256,27 +274,41 @@ class NDCGMetric(Metric):
         super().init(metadata, num_data)
         if metadata.query_boundaries is None:
             log.fatal("NDCG metric requires query information")
-        self.query_boundaries = np.asarray(metadata.query_boundaries)
+        qb = np.asarray(metadata.query_boundaries)
+        self.query_boundaries = qb
         self.query_weights = metadata.query_weights
+        # everything score-independent is precomputed once: row layout,
+        # per-row gains/discounts, and the per-k MAX DCG (label order is
+        # fixed) — eval then only sorts by score and segment-sums
+        lab = np.asarray(metadata.label).astype(int)
+        self._qid, self._pos = query_layout(qb)
+        self._gain = self.label_gain[
+            np.clip(lab, 0, len(self.label_gain) - 1)]
+        self._disc = 1.0 / np.log2(self._pos + 2.0)
+        by_label = np.lexsort((-lab, self._qid))
+        self._max_dcg = {
+            k: segment_sum(self._gain[by_label] * self._disc
+                           * (self._pos < k), qb)
+            for k in self.eval_at}
 
     def eval(self, score, objective):
         score = np.asarray(score, np.float64)
         qb = self.query_boundaries
         nq = len(qb) - 1
-        results = np.zeros((len(self.eval_at), nq))
         qw = self.query_weights if self.query_weights is not None else np.ones(nq)
-        for q in range(nq):
-            s, e = qb[q], qb[q + 1]
-            lab = self.label[s:e].astype(int)
-            sc = score[s:e]
-            order = np.argsort(-sc, kind="mergesort")
-            ideal = np.argsort(-lab, kind="mergesort")
-            for ki, k in enumerate(self.eval_at):
-                max_dcg = _dcg_at_k(lab, ideal, k, self.label_gain)
-                if max_dcg <= 0:
-                    results[ki, q] = 1.0  # reference counts empty queries as 1
-                else:
-                    results[ki, q] = _dcg_at_k(lab, order, k, self.label_gain) / max_dcg
+        # rows sorted by (query, -score) stay query-contiguous, so DCG@k
+        # is a per-query segment sum of masked discounted gains — one
+        # vectorized pass over all queries (replaces the reference's OMP
+        # per-query loop, rank_metric.hpp / dcg_calculator)
+        by_score = np.lexsort((-score, self._qid))
+        gain_sorted = self._gain[by_score] * self._disc
+        results = np.zeros((len(self.eval_at), nq))
+        for ki, k in enumerate(self.eval_at):
+            dcg = segment_sum(gain_sorted * (self._pos < k), qb)
+            max_dcg = self._max_dcg[k]
+            # reference counts queries with no positive docs as 1
+            results[ki] = np.where(max_dcg > 0,
+                                   dcg / np.maximum(max_dcg, 1e-300), 1.0)
         sum_w = qw.sum()
         return [(self.name[ki], float(np.sum(results[ki] * qw) / sum_w))
                 for ki in range(len(self.eval_at))]
@@ -294,27 +326,34 @@ class MAPMetric(Metric):
         super().init(metadata, num_data)
         if metadata.query_boundaries is None:
             log.fatal("MAP metric requires query information")
-        self.query_boundaries = np.asarray(metadata.query_boundaries)
+        qb = np.asarray(metadata.query_boundaries)
+        self.query_boundaries = qb
         self.query_weights = metadata.query_weights
+        self._qid, self._pos = query_layout(qb)
+        self._rel_raw = (np.asarray(metadata.label) > 0).astype(np.float64)
+        self._row_start = np.repeat(qb[:-1], np.diff(qb))
 
     def eval(self, score, objective):
         score = np.asarray(score, np.float64)
         qb = self.query_boundaries
         nq = len(qb) - 1
-        results = np.zeros((len(self.eval_at), nq))
         qw = self.query_weights if self.query_weights is not None else np.ones(nq)
-        for q in range(nq):
-            s, e = qb[q], qb[q + 1]
-            rel = (self.label[s:e] > 0).astype(int)
-            order = np.argsort(-score[s:e], kind="mergesort")
-            rel_sorted = rel[order]
-            hits = np.cumsum(rel_sorted)
-            prec = hits / (np.arange(len(rel_sorted)) + 1.0)
-            for ki, k in enumerate(self.eval_at):
-                topk = min(k, len(rel_sorted))
-                num_rel = rel_sorted[:topk].sum()
-                if num_rel > 0:
-                    results[ki, q] = float(np.sum(prec[:topk] * rel_sorted[:topk]) / num_rel)
+        qid, pos = self._qid, self._pos
+        by_score = np.lexsort((-score, qid))
+        rel = self._rel_raw[by_score]
+        # within-query running hit count: inclusive cumsum minus the
+        # exclusive cumsum at each query's start (rows stay
+        # query-contiguous; excl has length n+1 so qb values of n are safe)
+        excl = np.concatenate([[0.0], np.cumsum(rel)])
+        hits = excl[1:] - excl[self._row_start]
+        prec_rel = (hits / (pos + 1.0)) * rel
+        results = np.zeros((len(self.eval_at), nq))
+        for ki, k in enumerate(self.eval_at):
+            at_k = pos < k
+            ap_sum = segment_sum(prec_rel * at_k, qb)
+            num_rel = segment_sum(rel * at_k, qb)
+            results[ki] = np.where(num_rel > 0,
+                                   ap_sum / np.maximum(num_rel, 1e-300), 0.0)
         sum_w = qw.sum()
         return [(self.name[ki], float(np.sum(results[ki] * qw) / sum_w))
                 for ki in range(len(self.eval_at))]

@@ -391,13 +391,74 @@ class CrossEntropyLambda(ObjectiveFunction):
         return jnp.log1p(jnp.exp(raw))
 
 
+def _lambdarank_pair_grads(score, gather, lab, mask, inv_max_dcg, gain_table,
+                           sigmoid):
+    """Pairwise lambda/hessian for ONE padded query batch [Qb, D].
+
+    The reference's O(cnt^2) doc-pair loop (rank_objective.hpp:83-160) as a
+    masked dense [Qb, D, D] computation. Returns per-doc (lam, hess)."""
+    s = score[gather]                            # [Qb, D]
+    s = jnp.where(mask, s, K_MIN_SCORE)
+    # sorted positions: position of each doc when sorted by score desc
+    order = jnp.argsort(-s, axis=1, stable=True)
+    pos = jnp.argsort(order, axis=1)             # pos[q, d] = rank of doc d
+    discount = 1.0 / jnp.log2(pos.astype(jnp.float32) + 2.0)
+    gain = gain_table[jnp.clip(lab, 0, gain_table.shape[0] - 1)]  # [Qb, D]
+    best = jnp.max(jnp.where(mask, s, -jnp.inf), axis=1, keepdims=True)
+    worst = jnp.min(jnp.where(mask, s, jnp.inf), axis=1, keepdims=True)
+    # pair tensors [Qb, D, D]: i = high, j = low
+    ds = s[:, :, None] - s[:, None, :]
+    valid = (mask[:, :, None] & mask[:, None, :]
+             & (lab[:, :, None] > lab[:, None, :]))
+    dcg_gap = gain[:, :, None] - gain[:, None, :]
+    paired_disc = jnp.abs(discount[:, :, None] - discount[:, None, :])
+    delta_ndcg = dcg_gap * paired_disc * inv_max_dcg[:, None, None]
+    norm = (best != worst)[:, :, None]
+    delta_ndcg = jnp.where(norm, delta_ndcg / (0.01 + jnp.abs(ds)), delta_ndcg)
+    p_lambda = 2.0 / (1.0 + jnp.exp(2.0 * sigmoid * ds))
+    p_hess = p_lambda * (2.0 - p_lambda)
+    lam_pair = jnp.where(valid, -delta_ndcg * p_lambda, 0.0)
+    hess_pair = jnp.where(valid, 2.0 * delta_ndcg * p_hess, 0.0)
+    lam = lam_pair.sum(axis=2) - lam_pair.sum(axis=1)
+    hess = hess_pair.sum(axis=2) + hess_pair.sum(axis=1)
+    return lam, hess
+
+
+@functools.partial(jax.jit, static_argnames=("sigmoid", "n_out"))
+def _lambdarank_bucket_grads(score, gather, lab, mask, inv_max_dcg,
+                             gain_table, sigmoid, n_out):
+    """All batches of one length bucket: arrays are [nb, Qb, D] (stacked
+    fixed-size batches); `lax.map` walks them SEQUENTIALLY so live pair
+    memory stays O(Qb * D^2) regardless of bucket population. Scatter-adds
+    each doc's lambda into flat [n_out] gradient/hessian accumulators."""
+    def one_batch(args):
+        g, l, m, inv = args
+        lam, hess = _lambdarank_pair_grads(score, g, l, m, inv, gain_table,
+                                           sigmoid)
+        lam = jnp.where(m, lam, 0.0)
+        hess = jnp.where(m, hess, 0.0)
+        return lam, hess
+
+    lam, hess = jax.lax.map(one_batch, (gather, lab, mask, inv_max_dcg))
+    idx = gather.reshape(-1)
+    grad_flat = jnp.zeros(n_out, jnp.float32).at[idx].add(lam.reshape(-1))
+    hess_flat = jnp.zeros(n_out, jnp.float32).at[idx].add(hess.reshape(-1))
+    return grad_flat, hess_flat
+
+
 class LambdarankNDCG(ObjectiveFunction):
     """reference: rank_objective.hpp:19-245. Per-query pairwise lambdas with
-    deltaNDCG weighting, computed as a masked `[D, D]` pairwise tensor per
-    padded query batch (the O(cnt^2) doc-pair loop, hpp:83-160, becomes a
-    vmapped dense computation; queries are processed in fixed-size padded
-    batches to bound memory)."""
+    deltaNDCG weighting.
+
+    MSLR-scale redesign: queries are grouped into power-of-two LENGTH
+    BUCKETS (16, 32, ..., next_pow2(max_docs)) and each bucket is processed
+    in fixed-size query batches, so pair-tensor memory is bounded by
+    O(batch * D_bucket^2) <= _PAIR_BUDGET elements — not O(Q * D_max^2) —
+    while a query with 1,200 docs still gets its exact full pair set (the
+    reference streams O(cnt^2) per query, hpp:83-160; it never samples)."""
     name = "lambdarank"
+    _PAIR_BUDGET = 1 << 24  # max elements in one [Qb, D, D] pair tensor
+    _MIN_BUCKET = 16
 
     def __init__(self, config: Config):
         self.sigmoid = config.objective_config.sigmoid
@@ -416,77 +477,64 @@ class LambdarankNDCG(ObjectiveFunction):
         sizes = np.diff(qb)
         self.max_docs = int(sizes.max())
         lab = np.asarray(metadata.label).astype(int)
-        # inverse max DCG at k per query (dcg_calculator.cpp CalMaxDCGAtK)
-        inv = np.zeros(nq)
-        for q in range(nq):
-            ls = np.sort(lab[qb[q]:qb[q + 1]])[::-1][:self.optimize_pos_at]
-            dcg = np.sum((self.label_gain[ls]) / np.log2(np.arange(len(ls)) + 2))
-            inv[q] = 1.0 / dcg if dcg > 0 else 0.0
-        # padded [Q, D] label / mask tensors
-        D = self.max_docs
-        pad_lab = np.zeros((nq, D), np.int32)
-        pad_mask = np.zeros((nq, D), bool)
-        for q in range(nq):
-            c = qb[q + 1] - qb[q]
-            pad_lab[q, :c] = lab[qb[q]:qb[q + 1]]
-            pad_mask[q, :c] = True
-        self._pad_label = jnp.asarray(pad_lab)
-        self._pad_mask = jnp.asarray(pad_mask)
-        self._inv_max_dcg = jnp.asarray(inv, jnp.float32)
-        self._qb = jnp.asarray(qb)
-        self._sizes = jnp.asarray(sizes)
-        # row gather index: for each query q, docs qb[q]..qb[q+1]
-        gather = np.zeros((nq, D), np.int64)
-        for q in range(nq):
-            c = qb[q + 1] - qb[q]
-            gather[q, :c] = np.arange(qb[q], qb[q + 1])
-        self._gather = jnp.asarray(gather)
-        self._weights_arr = self.weights
+        # inverse max DCG at k per query (dcg_calculator.cpp CalMaxDCGAtK),
+        # vectorized: rows sorted by (query, -label) stay query-contiguous,
+        # so per-query DCG is a segment sum over masked position discounts
+        # (segment_sum tolerates zero-size queries, unlike reduceat)
+        from .metrics import query_layout, segment_sum
+        qid, pos_in_q = query_layout(qb)
+        by_label = np.lexsort((-lab, qid))
+        contrib = np.where(
+            pos_in_q < self.optimize_pos_at,
+            self.label_gain[np.clip(lab[by_label], 0, len(self.label_gain) - 1)]
+            / np.log2(pos_in_q + 2.0), 0.0)
+        dcg = segment_sum(contrib, qb)
+        inv = np.where(dcg > 0, 1.0 / np.maximum(dcg, 1e-300), 0.0)
 
-    @functools.partial(jax.jit, static_argnums=(0,))
-    def _query_grads(self, score):
-        """[Q, D] padded pairwise lambda computation."""
-        s = score[self._gather]                      # [Q, D]
-        s = jnp.where(self._pad_mask, s, K_MIN_SCORE)
-        lab = self._pad_label
-        mask = self._pad_mask
-        D = s.shape[1]
-        # sorted positions: position of each doc when sorted by score desc
-        order = jnp.argsort(-s, axis=1, stable=True)
-        pos = jnp.argsort(order, axis=1)             # pos[q, d] = rank of doc d
-        discount = 1.0 / jnp.log2(pos.astype(jnp.float32) + 2.0)
-        gain = jnp.asarray(self.label_gain, jnp.float32)[jnp.clip(lab, 0, 30)]
-        best = jnp.max(jnp.where(mask, s, -jnp.inf), axis=1, keepdims=True)
-        worst = jnp.min(jnp.where(mask, s, jnp.inf), axis=1, keepdims=True)
-        # pair tensors [Q, D, D]: i = high, j = low
-        ds = s[:, :, None] - s[:, None, :]
-        valid = (mask[:, :, None] & mask[:, None, :]
-                 & (lab[:, :, None] > lab[:, None, :]))
-        dcg_gap = gain[:, :, None] - gain[:, None, :]
-        paired_disc = jnp.abs(discount[:, :, None] - discount[:, None, :])
-        delta_ndcg = dcg_gap * paired_disc * self._inv_max_dcg[:, None, None]
-        norm = (best != worst)[:, :, None]
-        delta_ndcg = jnp.where(norm, delta_ndcg / (0.01 + jnp.abs(ds)), delta_ndcg)
-        p_lambda = 2.0 / (1.0 + jnp.exp(2.0 * self.sigmoid * ds))
-        p_hess = p_lambda * (2.0 - p_lambda)
-        lam_pair = jnp.where(valid, -delta_ndcg * p_lambda, 0.0)
-        hess_pair = jnp.where(valid, 2.0 * delta_ndcg * p_hess, 0.0)
-        lam = lam_pair.sum(axis=2) - lam_pair.sum(axis=1)
-        hess = hess_pair.sum(axis=2) + hess_pair.sum(axis=1)
-        return lam, hess
+        # length buckets: D = next pow2 >= size (floored at _MIN_BUCKET)
+        D_of = np.maximum(
+            self._MIN_BUCKET,
+            2 ** np.ceil(np.log2(np.maximum(sizes, 1))).astype(int))
+        self._buckets = []
+        for D in sorted(set(D_of.tolist())):
+            qs = np.nonzero(D_of == D)[0]
+            Qb = max(1, self._PAIR_BUDGET // (D * D))
+            nb = -(-len(qs) // Qb)               # ceil
+            n_slots = nb * Qb
+            gather = np.zeros((n_slots, D), np.int64)
+            pad_lab = np.zeros((n_slots, D), np.int32)
+            pad_mask = np.zeros((n_slots, D), bool)
+            binv = np.zeros(n_slots, np.float32)
+            for slot, q in enumerate(qs):
+                c = sizes[q]
+                gather[slot, :c] = np.arange(qb[q], qb[q + 1])
+                pad_lab[slot, :c] = lab[qb[q]:qb[q + 1]]
+                pad_mask[slot, :c] = True
+                binv[slot] = inv[q]
+            shape3 = (nb, Qb, D)
+            self._buckets.append((
+                jnp.asarray(gather.reshape(shape3)),
+                jnp.asarray(pad_lab.reshape(shape3)),
+                jnp.asarray(pad_mask.reshape(shape3)),
+                jnp.asarray(binv.reshape(nb, Qb)),
+            ))
+        self._inv_max_dcg_np = inv
+        self._gain_table = jnp.asarray(self.label_gain, jnp.float32)
 
     def get_gradients(self, score):
-        lam, hess = self._query_grads(score)
         n = self.num_data
-        grad_flat = jnp.zeros(n, jnp.float32).at[self._gather.reshape(-1)].add(
-            jnp.where(self._pad_mask, lam, 0.0).reshape(-1))
-        hess_flat = jnp.zeros(n, jnp.float32).at[self._gather.reshape(-1)].add(
-            jnp.where(self._pad_mask, hess, 0.0).reshape(-1))
-        # padded slots all alias row qb[q] with zero contribution
+        grad = jnp.zeros(n, jnp.float32)
+        hess = jnp.zeros(n, jnp.float32)
+        for gather, lab, mask, inv in self._buckets:
+            g, h = _lambdarank_bucket_grads(
+                score, gather, lab, mask, inv, self._gain_table,
+                self.sigmoid, n)
+            grad = grad + g
+            hess = hess + h
         if self.weights is not None:
-            grad_flat = grad_flat * self.weights
-            hess_flat = hess_flat * self.weights
-        return grad_flat, hess_flat
+            grad = grad * self.weights
+            hess = hess * self.weights
+        return grad, hess
 
 
 _OBJECTIVE_REGISTRY = {

@@ -1,0 +1,208 @@
+"""Ranking-path tests: bucketed lambdarank gradients, vectorized NDCG/MAP
+metrics (brute-force-matched), and an MSLR-WEB30K-shaped memory test
+(VERDICT r1 item 6: queries up to >1,200 docs must train without the
+O(Q * D_max^2) padded pair tensor blowing up).
+
+Reference semantics: rank_objective.hpp:83-160 (pairwise lambdas),
+rank_metric.hpp + dcg_calculator.cpp (NDCG), map_metric.hpp (MAP).
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.dataset import Metadata
+from lightgbm_tpu.metrics import MAPMetric, NDCGMetric
+from lightgbm_tpu.objectives import LambdarankNDCG
+
+LABEL_GAIN = np.array([float((1 << i) - 1) for i in range(31)])
+
+
+@pytest.fixture()
+def ranked_data():
+    rng = np.random.RandomState(3)
+    sizes = rng.randint(1, 60, size=40)
+    qb = np.concatenate([[0], np.cumsum(sizes)])
+    n = int(qb[-1])
+    lab = rng.randint(0, 5, size=n)
+    score = rng.randn(n)
+    md = Metadata(n)
+    md.set_label(lab.astype(np.float32))
+    md.set_group(sizes)
+    return md, qb, lab, score, n
+
+
+def _dcg_at_k(labels, order, k):
+    top = order[:k]
+    disc = 1.0 / np.log2(np.arange(len(top)) + 2.0)
+    return float(np.sum(LABEL_GAIN[labels[top]] * disc))
+
+
+def test_ndcg_matches_bruteforce(ranked_data):
+    md, qb, lab, score, n = ranked_data
+    cfg = Config.from_params({"objective": "lambdarank", "metric": "ndcg",
+                              "ndcg_eval_at": [1, 3, 5, 10]})
+    m = NDCGMetric(cfg)
+    m.init(md, n)
+    res = dict(m.eval(score, None))
+    for k in (1, 3, 5, 10):
+        vals = []
+        for q in range(len(qb) - 1):
+            s, e = qb[q], qb[q + 1]
+            l, sc = lab[s:e], score[s:e]
+            o = np.argsort(-sc, kind="mergesort")
+            i_ = np.argsort(-l, kind="mergesort")
+            mx = _dcg_at_k(l, i_, k)
+            vals.append(_dcg_at_k(l, o, k) / mx if mx > 0 else 1.0)
+        assert abs(np.mean(vals) - res[f"ndcg@{k}"]) < 1e-9
+
+
+def test_map_matches_bruteforce(ranked_data):
+    md, qb, lab, score, n = ranked_data
+    cfg = Config.from_params({"objective": "lambdarank", "metric": "map",
+                              "ndcg_eval_at": [1, 3, 5, 10]})
+    m = MAPMetric(cfg)
+    m.init(md, n)
+    res = dict(m.eval(score, None))
+    for k in (1, 3, 5, 10):
+        vals = []
+        for q in range(len(qb) - 1):
+            s, e = qb[q], qb[q + 1]
+            rel = (lab[s:e] > 0).astype(int)
+            o = np.argsort(-score[s:e], kind="mergesort")
+            rs = rel[o]
+            hits = np.cumsum(rs)
+            prec = hits / (np.arange(len(rs)) + 1.0)
+            topk = min(k, len(rs))
+            nr = rs[:topk].sum()
+            vals.append(np.sum(prec[:topk] * rs[:topk]) / nr if nr > 0 else 0.0)
+        assert abs(np.mean(vals) - res[f"map@{k}"]) < 1e-9
+
+
+def test_lambdarank_gradients_match_bruteforce(ranked_data):
+    """Bucketed [Qb, D, D] pair gradients == reference's per-query O(cnt^2)
+    doc-pair loop (rank_objective.hpp:83-160)."""
+    import jax.numpy as jnp
+    md, qb, lab, score, n = ranked_data
+    cfg = Config.from_params({"objective": "lambdarank"})
+    obj = LambdarankNDCG(cfg)
+    obj.init(md, n)
+    g, h = obj.get_gradients(jnp.asarray(score, jnp.float32))
+    g, h = np.asarray(g), np.asarray(h)
+
+    sig = cfg.objective_config.sigmoid
+    inv = obj._inv_max_dcg_np
+    bg, bh = np.zeros(n), np.zeros(n)
+    for q in range(len(qb) - 1):
+        s_, e_ = qb[q], qb[q + 1]
+        sc = score[s_:e_].astype(np.float32)
+        l = lab[s_:e_]
+        c = e_ - s_
+        order = np.argsort(-sc, kind="stable")
+        pos = np.argsort(order, kind="stable")
+        disc = 1.0 / np.log2(pos.astype(np.float32) + 2.0)
+        gn = LABEL_GAIN[l].astype(np.float32)
+        best, worst = sc.max(), sc.min()
+        for i in range(c):
+            for j in range(c):
+                if l[i] <= l[j]:
+                    continue
+                ds = sc[i] - sc[j]
+                dn = (gn[i] - gn[j]) * abs(disc[i] - disc[j]) * inv[q]
+                if best != worst:
+                    dn = dn / (0.01 + abs(ds))
+                pl = 2.0 / (1.0 + np.exp(2.0 * sig * ds))
+                ph = pl * (2.0 - pl)
+                bg[s_ + i] += -dn * pl
+                bg[s_ + j] -= -dn * pl
+                bh[s_ + i] += 2.0 * dn * ph
+                bh[s_ + j] += 2.0 * dn * ph
+    assert np.abs(g - bg).max() < 1e-3
+    assert np.abs(h - bh).max() < 1e-3
+
+
+def test_lambdarank_bucket_shapes():
+    """Pair-tensor batches stay within the budget even with one huge query
+    (the MSLR shape: doc counts 1..1,200+)."""
+    rng = np.random.RandomState(1)
+    sizes = np.concatenate([rng.randint(1, 200, size=300), [1250]])
+    qb = np.concatenate([[0], np.cumsum(sizes)])
+    n = int(qb[-1])
+    md = Metadata(n)
+    md.set_label(rng.randint(0, 5, size=n).astype(np.float32))
+    md.set_group(sizes)
+    cfg = Config.from_params({"objective": "lambdarank"})
+    obj = LambdarankNDCG(cfg)
+    obj.init(md, n)
+    budget = LambdarankNDCG._PAIR_BUDGET
+    for gather, lab, mask, inv in obj._buckets:
+        nb, Qb, D = gather.shape
+        assert Qb * D * D <= max(budget, D * D), (Qb, D)
+    # every real doc appears exactly once across buckets
+    import jax.numpy as jnp
+    total_docs = sum(int(m.sum()) for _, _, m, _ in obj._buckets)
+    assert total_docs == n
+
+
+def test_lambdarank_mslr_shape_trains():
+    """Scaled-down MSLR-WEB30K shape: long-tailed query lengths incl. a
+    >1,200-doc query; must train without OOM and improve NDCG@10."""
+    rng = np.random.RandomState(5)
+    sizes = np.concatenate([rng.randint(5, 150, size=200), [1250]])
+    qb = np.concatenate([[0], np.cumsum(sizes)])
+    n = int(qb[-1])
+    X = rng.randn(n, 8).astype(np.float32)
+    rel = np.clip(X[:, 0] * 1.2 + 0.4 * rng.randn(n), 0, None)
+    y = np.minimum(rel.astype(int), 4)
+    ds = lgb.Dataset(X, y, group=sizes)
+    params = {"objective": "lambdarank", "metric": "ndcg",
+              "ndcg_eval_at": [10], "verbose": -1, "num_leaves": 31,
+              "min_data_in_leaf": 5}
+    evals = {}
+    gbm = lgb.train(params, ds, num_boost_round=8, valid_sets=[ds],
+                    valid_names=["train"], evals_result=evals,
+                    verbose_eval=False)
+    hist = evals["train"]["ndcg@10"]
+    assert hist[-1] > hist[0]
+
+
+def test_empty_query_groups():
+    """Zero-size query groups must not break the vectorized metric /
+    objective segment sums (empty queries count as NDCG 1.0, MAP 0.0)."""
+    import jax.numpy as jnp
+    sizes = np.array([3, 0, 2, 0])
+    qb = np.concatenate([[0], np.cumsum(sizes)])
+    n = int(qb[-1])
+    lab = np.array([2, 0, 1, 1, 0], np.float32)
+    score = np.array([0.5, 0.1, 0.9, 0.3, -0.2])
+    md = Metadata(n)
+    md.set_label(lab)
+    md.set_group(sizes)
+    cfg = Config.from_params({"objective": "lambdarank", "metric": "ndcg",
+                              "ndcg_eval_at": [2]})
+    m = NDCGMetric(cfg)
+    m.init(md, n)
+    (_, v), = m.eval(score, None)
+    # brute-force: empty queries score 1.0
+    vals = []
+    for q in range(len(qb) - 1):
+        s, e = qb[q], qb[q + 1]
+        l = lab[s:e].astype(int)
+        if e == s:
+            vals.append(1.0)
+            continue
+        o = np.argsort(-score[s:e], kind="mergesort")
+        i_ = np.argsort(-l, kind="mergesort")
+        mx = _dcg_at_k(l, i_, 2)
+        vals.append(_dcg_at_k(l, o, 2) / mx if mx > 0 else 1.0)
+    assert abs(v - np.mean(vals)) < 1e-9
+
+    m2 = MAPMetric(cfg)
+    m2.init(md, n)
+    (_, v2), = m2.eval(score, None)
+    assert np.isfinite(v2)
+
+    obj = LambdarankNDCG(cfg)
+    obj.init(md, n)
+    g, h = obj.get_gradients(jnp.asarray(score, jnp.float32))
+    assert np.isfinite(np.asarray(g)).all()
