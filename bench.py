@@ -51,7 +51,7 @@ def main():
         "learning_rate": 0.1, "min_data_in_leaf": 1,
         "min_sum_hessian_in_leaf": 100.0,
     }
-    ds = lgb.Dataset(X, y)
+    ds = lgb.Dataset(X, y, params=dict(params))
     ds.construct()
 
     # warmup: compile the grower (first tree)

@@ -69,6 +69,39 @@ class Dataset:
         self._inner: Optional[_InnerDataset] = None
         self.used_indices: Optional[np.ndarray] = None
         self._predictor = None
+        self._constructed_max_bin: Optional[int] = None
+
+    def _update_params(self, params: Dict[str, Any]) -> "Dataset":
+        """Fold training-time params into the not-yet-constructed dataset
+        (reference: basic.py Dataset._update_params — binning params like
+        max_bin given to lgb.train() must reach the lazy construction)."""
+        if not params:
+            return self
+        if self._inner is not None:
+            pk = key_alias_transform(dict(params))
+            new_bin = pk.get("max_bin")
+            if new_bin is not None and int(new_bin) != self._constructed_max_bin:
+                log.warning(
+                    "Dataset already constructed with max_bin=%d; "
+                    "ignoring max_bin=%s from training params",
+                    self._constructed_max_bin, new_bin)
+            # any construction-time param that differs from what the lazy
+            # init saw can no longer take effect (reference: "Cannot
+            # change ... after constructed")
+            bin_defaults = {
+                "min_data_in_bin": 3, "bin_construct_sample_cnt": 200000,
+                "enable_bundle": True, "max_conflict_rate": 0.0,
+                "use_missing": True, "zero_as_missing": False,
+                "sparse_threshold": 0.8, "data_random_seed": 1}
+            for key, default in bin_defaults.items():
+                if key in pk and \
+                        str(pk[key]) != str(self.params.get(key, default)):
+                    log.warning(
+                        "Dataset already constructed; ignoring %s=%s from "
+                        "training params", key, pk[key])
+            return self
+        self.params.update(params)
+        return self
 
     # ------------------------------------------------------------------
     def _lazy_init(self) -> _InnerDataset:
@@ -144,6 +177,7 @@ class Dataset:
                            and params.get("tree_learner", "serial") != "feature"),
             max_conflict_rate=float(params.get("max_conflict_rate", 0.0)),
             sparse_threshold=float(params.get("sparse_threshold", 0.8)))
+        self._constructed_max_bin = max_bin
         return self._inner
 
     def construct(self) -> "Dataset":
@@ -266,6 +300,7 @@ class Booster:
         self.name_valid_sets: List[str] = []
         self.best_iteration = -1
         self.best_score: Dict = {}
+        self._train_data_name = "training"
 
         if train_set is not None:
             cfg = Config.from_params(self.params)
@@ -356,8 +391,14 @@ class Booster:
         return self._inner.num_trees()
 
     # ------------------------------------------------------------------
+    def set_train_data_name(self, name: str) -> "Booster":
+        """Name used for the training data in eval results (reference:
+        basic.py Booster.set_train_data_name)."""
+        self._train_data_name = name
+        return self
+
     def eval_train(self, feval=None) -> List:
-        return self.__inner_eval("training", -1, feval)
+        return self.__inner_eval(self._train_data_name, -1, feval)
 
     def eval_valid(self, feval=None) -> List:
         out = []
@@ -403,10 +444,15 @@ class Booster:
     # ------------------------------------------------------------------
     def predict(self, data, num_iteration: int = -1, raw_score: bool = False,
                 pred_leaf: bool = False, pred_contrib: bool = False,
-                data_has_header: bool = False, is_reshape: bool = True):
+                data_has_header: bool = False, is_reshape: bool = True,
+                pred_early_stop: bool = False, pred_early_stop_freq: int = 10,
+                pred_early_stop_margin: float = 10.0):
         arr = _data_to_2d(data)
         return self._inner.predict(arr, num_iteration, raw_score, pred_leaf,
-                                   pred_contrib)
+                                   pred_contrib,
+                                   pred_early_stop=pred_early_stop,
+                                   pred_early_stop_freq=pred_early_stop_freq,
+                                   pred_early_stop_margin=pred_early_stop_margin)
 
     # ------------------------------------------------------------------
     def save_model(self, filename: str, num_iteration: int = -1) -> "Booster":

@@ -49,6 +49,63 @@ def _pad_to(arr: np.ndarray, n: int, value=0):
     return np.pad(arr, width, constant_values=value)
 
 
+# small-state fields fetched host-side to build the Tree (everything
+# from_grower_state reads — NOT leaf_id/split_bit/lbest/..., which stay
+# on device)
+_SMALL_STATE_KEYS = (
+    "num_leaves_used", "leaf_value", "count", "node_feature",
+    "node_threshold", "node_default_left", "node_is_cat", "node_left",
+    "node_right", "node_gain", "node_value", "node_count", "num_passes",
+    "comm_elems")
+
+
+class _HostState:
+    """Host-numpy view of the grower small state (duck-typed for
+    Tree.from_grower_state)."""
+
+    def __init__(self, d):
+        self.__dict__.update(d)
+
+
+def _grow_and_update_impl(score, binned, grad, hess, row_weight, fmask,
+                          shrinkage, fmeta_args, cls, cfg):
+    """grow one tree + train-score update, fused into ONE device program.
+
+    On a relay-attached TPU every eager op dispatch is a host round trip;
+    fusing the per-tree path (grow -> leaf gather -> score add) plus
+    returning only the small tree arrays cuts per-tree host traffic to one
+    dispatch + one device_get (profiled round 2: the eager chain cost
+    ~3x the tree growth itself)."""
+    import jax.numpy as jnp
+
+    state = grow_tree(binned, grad, hess, row_weight, fmask, *fmeta_args,
+                      cfg)
+    grew = state.num_leaves_used > 1
+    leaf_vals = state.leaf_value * shrinkage
+    delta = jnp.where(
+        grew,
+        leaf_vals[jnp.clip(state.leaf_id, 0, cfg.num_leaves - 1)], 0.0)
+    score = score.at[cls].add(delta)
+    small = {k: getattr(state, k) for k in _SMALL_STATE_KEYS}
+    return score, small
+
+
+def _grow_and_update(score, binned, grad, hess, row_weight, fmask,
+                     shrinkage, fmeta_args, cls, cfg):
+    import jax
+    import jax.numpy as jnp
+    global _grow_and_update_jit
+    if _grow_and_update_jit is None:
+        _grow_and_update_jit = jax.jit(
+            _grow_and_update_impl, static_argnames=("cls", "cfg"))
+    return _grow_and_update_jit(score, binned, grad, hess, row_weight,
+                                fmask, jnp.float32(shrinkage),
+                                tuple(fmeta_args), cls=cls, cfg=cfg)
+
+
+_grow_and_update_jit = None
+
+
 class GBDT:
     """Reference: class GBDT, gbdt.h:25-441."""
 
@@ -292,7 +349,13 @@ class GBDT:
 
     # ------------------------------------------------------------------
     def _compute_gradients(self, score) -> Tuple:
-        return self.objective.get_gradients(score.reshape(-1))
+        # one jitted program per iteration instead of an eager op chain
+        # (each eager dispatch is a host round trip on relay-attached TPUs)
+        if getattr(self, "_jit_grads", None) is None:
+            import jax
+            self._jit_grads = jax.jit(
+                lambda s: self.objective.get_gradients(s.reshape(-1)))
+        return self._jit_grads(score)
 
     def train_one_iter(self, gradients: Optional[np.ndarray] = None,
                        hessians: Optional[np.ndarray] = None) -> bool:
@@ -301,13 +364,17 @@ class GBDT:
         (training should stop)."""
         import jax.numpy as jnp
 
+        from .. import tracing
+
         k = self.num_tree_per_iteration
         n_pad = self._n_pad
         if gradients is None or hessians is None:
             if self.objective is None:
                 log.fatal("Custom objective training requires explicit "
                           "gradients and hessians")
-            grad, hess = self._compute_gradients(self._score)
+            with tracing.phase("boosting/gradients"):
+                grad, hess = self._compute_gradients(self._score)
+                tracing.block(grad)
         else:
             grad = jnp.asarray(np.asarray(gradients, np.float32).reshape(k, -1))
             hess = jnp.asarray(np.asarray(hessians, np.float32).reshape(k, -1))
@@ -319,26 +386,61 @@ class GBDT:
         grad = grad.reshape(k, n_pad)
         hess = hess.reshape(k, n_pad)
 
-        bag = self._bagging_weights(self.iter_, grad, hess)
-        row_weight = self._base_weight if bag is None else \
-            jnp.asarray(_pad_to(bag, n_pad))
+        with tracing.phase("boosting/bagging"):
+            bag = self._bagging_weights(self.iter_, grad, hess)
+            row_weight = self._base_weight if bag is None else \
+                jnp.asarray(_pad_to(bag, n_pad))
 
+        import jax
+
+        from ..learner.grow import FMETA_KEYS
         could_split_any = False
         for cls in range(k):
             mask = self._feature_mask()
-            state = self._grow(grad[cls], hess[cls], row_weight, mask)
-            tree = Tree.from_grower_state(state, self.train_data)
+            if self._dist_grower is None:
+                # serial learner: grow + score update as ONE device
+                # program, then ONE host fetch of the small tree arrays
+                with tracing.phase("tree/grow"):
+                    self._score, small = _grow_and_update(
+                        self._score, self._binned, grad[cls], hess[cls],
+                        row_weight, jnp.asarray(mask), self.shrinkage_rate,
+                        [self._fmeta[key] for key in FMETA_KEYS], cls,
+                        self._grower_cfg)
+                with tracing.phase("tree/extract"):
+                    host_state = _HostState(jax.device_get(small))
+                    tree = Tree.from_grower_state(host_state,
+                                                  self.train_data)
+                if tree.num_leaves > 1:
+                    tree.apply_shrinkage(self.shrinkage_rate)
+            else:
+                with tracing.phase("tree/grow"):
+                    state = self._grow(grad[cls], hess[cls], row_weight,
+                                       mask)
+                with tracing.phase("tree/extract"):
+                    small = {key: getattr(state, key)
+                             for key in _SMALL_STATE_KEYS}
+                    host_state = _HostState(jax.device_get(small))
+                    tree = Tree.from_grower_state(host_state,
+                                                  self.train_data)
+                if tree.num_leaves > 1:
+                    tree.apply_shrinkage(self.shrinkage_rate)
+                    # train score update via leaf ids (UpdateScore,
+                    # gbdt.cpp:521)
+                    with tracing.phase("boosting/update_score"):
+                        leaf_vals = jnp.asarray(tree.leaf_value, jnp.float32)
+                        self._score = self._score.at[cls].add(
+                            leaf_vals[jnp.clip(state.leaf_id, 0,
+                                               tree.num_leaves - 1)])
+
             if tree.num_leaves > 1:
                 could_split_any = True
-                tree.apply_shrinkage(self.shrinkage_rate)
-                # train score update via leaf ids (UpdateScore, gbdt.cpp:521)
-                leaf_vals = jnp.asarray(tree.leaf_value, jnp.float32)
-                self._score = self._score.at[cls].add(
-                    leaf_vals[jnp.clip(state.leaf_id, 0, tree.num_leaves - 1)])
-                dtree = tree.to_device()
-                for vi in range(len(self.valid_sets)):
-                    self._valid_score[vi] = self._valid_score[vi].at[cls].add(
-                        predict_value_binned(dtree, self._valid_binned[vi]))
+                with tracing.phase("boosting/update_valid_score"):
+                    dtree = tree.to_device() if self.valid_sets else None
+                    for vi in range(len(self.valid_sets)):
+                        self._valid_score[vi] = \
+                            self._valid_score[vi].at[cls].add(
+                                predict_value_binned(
+                                    dtree, self._valid_binned[vi]))
                 # fold boost-from-average into the tree AFTER the score
                 # update (scores were bumped at init): gbdt.cpp:445-447
                 if abs(getattr(self, "_pending_bias", 0.0)) > _K_EPSILON:
@@ -410,19 +512,39 @@ class GBDT:
     # ------------------------------------------------------------------
     # prediction (reference: gbdt_prediction.cpp + Predictor)
     def _predict_raw_matrix(self, data: np.ndarray,
-                            num_iteration: int = -1) -> np.ndarray:
+                            num_iteration: int = -1,
+                            pred_early_stop: bool = False,
+                            pred_early_stop_freq: int = 10,
+                            pred_early_stop_margin: float = 10.0) -> np.ndarray:
         """Raw scores [num_data, num_tree_per_iteration] from raw features."""
+        import jax
         import jax.numpy as jnp
-        from ..ops.predict import predict_value_raw
         data = np.asarray(data, np.float32)
         n = data.shape[0]
         k = self.num_tree_per_iteration
         total = len(self.models)
         if num_iteration > 0:
             total = min(total, num_iteration * k)
+        # margin-based prediction early stop (predictor.hpp:34-60: binary
+        # and multiclass objectives only)
+        use_es = (pred_early_stop and total > 0
+                  and (k > 1 or (self.objective is not None
+                                 and self.objective.name == "binary")))
         out = np.zeros((k, n), np.float64)
         dj = jnp.asarray(data)
-        if total > 0:
+        if use_es:
+            from ..ops.predict import (predict_forest_raw_early_stop,
+                                       stack_trees_raw)
+            t_iters = total // k
+            stacked = stack_trees_raw(self.models[:t_iters * k])
+            # iteration-major [T*K, ...] -> [K, T, ...]
+            stacked_kt = jax.tree.map(
+                lambda a: jnp.swapaxes(
+                    a.reshape((t_iters, k) + a.shape[1:]), 0, 1), stacked)
+            out = np.asarray(predict_forest_raw_early_stop(
+                stacked_kt, dj, float(pred_early_stop_margin),
+                int(pred_early_stop_freq)), np.float64)
+        elif total > 0:
             from ..ops.predict import predict_forest_raw, stack_trees_raw
             for cls in range(k):
                 class_trees = [self.models[i] for i in range(cls, total, k)]
@@ -438,7 +560,10 @@ class GBDT:
 
     def predict(self, data: np.ndarray, num_iteration: int = -1,
                 raw_score: bool = False, pred_leaf: bool = False,
-                pred_contrib: bool = False) -> np.ndarray:
+                pred_contrib: bool = False,
+                pred_early_stop: bool = False,
+                pred_early_stop_freq: int = 10,
+                pred_early_stop_margin: float = 10.0) -> np.ndarray:
         import jax.numpy as jnp
         if pred_leaf:
             from ..ops.predict import predict_leaf_raw
@@ -455,7 +580,10 @@ class GBDT:
         if pred_contrib:
             from ..shap import predict_contrib
             return predict_contrib(self, np.asarray(data, np.float64), num_iteration)
-        raw = self._predict_raw_matrix(data, num_iteration)
+        raw = self._predict_raw_matrix(
+            data, num_iteration, pred_early_stop=pred_early_stop,
+            pred_early_stop_freq=pred_early_stop_freq,
+            pred_early_stop_margin=pred_early_stop_margin)
         if raw_score or self.objective is None:
             return raw[:, 0] if raw.shape[1] == 1 else raw
         conv = np.asarray(self.objective.convert_output(

@@ -87,13 +87,29 @@ def run_train(params: Dict, cfg: Config) -> None:
         valid_sets.append(_build_dataset(vpath, params, cfg, reference=train_set))
         valid_names.append(os.path.basename(vpath))
 
+    callbacks = []
+    if cfg.io.snapshot_freq > 0:
+        # periodic model snapshots (reference: GBDT::Train, gbdt.cpp:349-353
+        # — writes <output_model>.snapshot_iter_N every snapshot_freq iters)
+        freq, out = cfg.io.snapshot_freq, cfg.io.output_model
+
+        def _snapshot(env):
+            it = env.iteration + 1
+            if it % freq == 0:
+                path = f"{out}.snapshot_iter_{it}"
+                env.model.save_model(path)
+                log.info("Saved snapshot to %s", path)
+
+        callbacks.append(_snapshot)
+
     booster = train(params, train_set,
                     num_boost_round=cfg.boosting.num_iterations,
                     valid_sets=valid_sets, valid_names=valid_names,
                     verbose_eval=cfg.metric.metric_freq
                     if cfg.io.verbosity >= 1 else False,
                     early_stopping_rounds=cfg.boosting.early_stopping_round
-                    or None)
+                    or None,
+                    callbacks=callbacks)
     booster.save_model(cfg.io.output_model)
     log.info("Finished training, model saved to %s", cfg.io.output_model)
 
@@ -112,7 +128,10 @@ def run_predict(params: Dict, cfg: Config) -> None:
         num_iteration=cfg.io.num_iteration_predict,
         raw_score=cfg.io.is_predict_raw_score,
         pred_leaf=cfg.io.is_predict_leaf_index,
-        pred_contrib=cfg.io.is_predict_contrib)
+        pred_contrib=cfg.io.is_predict_contrib,
+        pred_early_stop=cfg.io.pred_early_stop,
+        pred_early_stop_freq=cfg.io.pred_early_stop_freq,
+        pred_early_stop_margin=cfg.io.pred_early_stop_margin)
     result = np.atleast_1d(np.asarray(result))
     with open(cfg.io.output_result, "w") as fh:
         for row in result:

@@ -38,6 +38,17 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
     if categorical_feature != "auto":
         train_set.categorical_feature = categorical_feature
 
+    valid_sets = valid_sets or []
+    if isinstance(valid_sets, Dataset):
+        valid_sets = [valid_sets]
+    valid_sets = list(valid_sets)
+
+    # binning params given at train time reach the lazy datasets
+    # (reference: engine.py / basic.py Dataset._update_params)
+    train_set._update_params(params)
+    for vs in valid_sets:
+        vs._update_params(params)
+
     booster = Booster(params=params, train_set=train_set)
     if init_model is not None:
         init_booster = init_model if isinstance(init_model, Booster) \
@@ -45,9 +56,6 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
         # continued training: seed scores with the loaded model's predictions
         _continue_from(booster, init_booster, train_set)
 
-    valid_sets = valid_sets or []
-    if isinstance(valid_sets, Dataset):
-        valid_sets = [valid_sets]
     valid_names = valid_names or [f"valid_{i}" for i in range(len(valid_sets))]
     is_valid_contain_train = False
     train_data_name = "training"
@@ -59,6 +67,7 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
         booster.add_valid(vs, valid_names[i])
     if is_valid_contain_train:
         booster._inner.config.metric.is_provide_training_metric = True
+        booster.set_train_data_name(train_data_name)
 
     # assemble callbacks (engine.py:150-188)
     callbacks = list(callbacks or [])

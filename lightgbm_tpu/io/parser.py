@@ -54,6 +54,22 @@ def load_data_file(path: str, has_header: bool = False,
     if fmt == "libsvm":
         return _load_libsvm(path)
     delim = "," if fmt == "csv" else None
+    # native fast path for single-character delimiters (tab/comma); the
+    # whitespace-split variant stays in Python
+    native_delim = None
+    if fmt == "csv":
+        native_delim = ","
+    elif fmt == "tsv":
+        with open(path) as fh:
+            first = fh.readline()
+        if "\t" in first:
+            native_delim = "\t"
+    if native_delim is not None:
+        mat = _native_parse(path, native_delim, has_header)
+        if mat is not None:
+            labels = mat[:, label_column]
+            data = np.delete(mat, label_column, axis=1)
+            return np.ascontiguousarray(data), labels.copy()
     rows: List[List[float]] = []
     labels: List[float] = []
     with open(path) as fh:
@@ -69,6 +85,48 @@ def load_data_file(path: str, has_header: bool = False,
             rows.append(vals[:label_column] + vals[label_column + 1:])
     data = np.asarray(rows, np.float64)
     return data, np.asarray(labels, np.float64)
+
+
+_native_lib = None
+_native_tried = False
+
+
+def _native_parse(path: str, delim: str, has_header: bool):
+    """Parse via native/parser_native.so (native/parser.cpp) when built;
+    returns None to fall back to the Python path."""
+    global _native_lib, _native_tried
+    if not _native_tried:
+        _native_tried = True
+        import ctypes
+        so = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))),
+            "native", "parser_native.so")
+        if os.path.exists(so):
+            try:
+                lib = ctypes.CDLL(so)
+                lib.lgbm_tpu_parse_dense.restype = ctypes.c_int
+                lib.lgbm_tpu_free.argtypes = [ctypes.POINTER(ctypes.c_double)]
+                _native_lib = lib
+            except OSError as e:
+                log.warning("native parser unavailable: %s", e)
+    if _native_lib is None:
+        return None
+    import ctypes
+    rows = ctypes.c_int64(0)
+    cols = ctypes.c_int64(0)
+    data = ctypes.POINTER(ctypes.c_double)()
+    rc = _native_lib.lgbm_tpu_parse_dense(
+        path.encode(), ctypes.c_char(delim.encode()),
+        1 if has_header else 0, ctypes.byref(rows), ctypes.byref(cols),
+        ctypes.byref(data))
+    if rc != 0:
+        return None
+    try:
+        mat = np.ctypeslib.as_array(
+            data, shape=(rows.value, cols.value)).copy()
+    finally:
+        _native_lib.lgbm_tpu_free(data)
+    return mat
 
 
 def _parse_float(tok: str) -> float:

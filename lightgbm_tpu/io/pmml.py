@@ -1,0 +1,138 @@
+"""PMML exporter (reference: pmml/pmml.py, 149 LoC — regression/binary
+models only).
+
+Emits a PMML 4.2 MiningModel whose segmentation sums one TreeModel per
+boosted tree, predicates from the raw-space thresholds. Works from Tree
+objects instead of re-parsing model text (the reference script walks the
+text file); categorical one-vs-rest splits map to equal/notEqual
+predicates like the reference's decision_type==1 case.
+
+Usage:
+    python -m lightgbm_tpu.io.pmml model.txt > model.pmml
+    from lightgbm_tpu.io.pmml import model_to_pmml
+"""
+from __future__ import annotations
+
+import itertools
+from typing import List
+
+
+def _tree_nodes(tree, feature_names: List[str], out: List[str],
+                unique_id, indent: int) -> None:
+    def emit(line, depth):
+        out.append("\t" * depth + line)
+
+    def predicate(parent_idx: int, is_left: bool, depth: int) -> None:
+        feat = feature_names[tree.split_feature[parent_idx]]
+        is_cat = bool(tree.decision_type[parent_idx] & 1)
+        if is_cat:
+            op = "equal" if is_left else "notEqual"
+            # one-vs-rest: the single raw category in the node's bitset
+            val = _cat_value(tree, parent_idx)
+        else:
+            op = "lessOrEqual" if is_left else "greaterThan"
+            val = tree.threshold[parent_idx]
+        emit(f'<SimplePredicate field="{feat}"  operator="{op}" '
+             f'value="{val}" />', depth + 1)
+
+    def walk(node_id: int, depth: int, is_left: bool, parent_idx: int):
+        if node_id < 0:
+            leaf = ~node_id
+            score = tree.leaf_value[leaf]
+            count = int(tree.leaf_count[leaf])
+            emit(f'<Node id="{next(unique_id)}" score="{score}" '
+                 f' recordCount="{count}">', depth)
+            predicate(parent_idx, is_left, depth)
+            emit("</Node>", depth)
+            return
+        score = tree.internal_value[node_id]
+        count = int(tree.internal_count[node_id])
+        emit(f'<Node id="{next(unique_id)}" score="{score}" '
+             f' recordCount="{count}">', depth)
+        predicate(parent_idx, is_left, depth)
+        walk(tree.left_child[node_id], depth + 1, True, node_id)
+        walk(tree.right_child[node_id], depth + 1, False, node_id)
+        emit("</Node>", depth)
+
+    emit('<TreeModel functionName="regression" '
+         'splitCharacteristic="binarySplit">', indent)
+    emit("<MiningSchema>", indent + 1)
+    for name in feature_names:
+        emit(f'<MiningField name="{name}"/>', indent + 2)
+    emit("</MiningSchema>", indent + 1)
+    if tree.num_leaves <= 1:
+        emit(f'<Node id="{next(unique_id)}" score="{tree.leaf_value[0]}" '
+             f'recordCount="{int(tree.leaf_count[0])}">', indent + 1)
+        emit("<True/>", indent + 2)
+        emit("</Node>", indent + 1)
+    else:
+        emit(f'<Node id="{next(unique_id)}" '
+             f'score="{tree.internal_value[0]}" '
+             f'recordCount="{int(tree.internal_count[0])}">', indent + 1)
+        emit("<True/>", indent + 2)
+        walk(tree.left_child[0], indent + 2, True, 0)
+        walk(tree.right_child[0], indent + 2, False, 0)
+        emit("</Node>", indent + 1)
+    emit("</TreeModel>", indent)
+
+
+def _cat_value(tree, node_idx: int):
+    idx = int(tree.threshold_in_bin[node_idx])
+    lo, hi = tree.cat_boundaries[idx], tree.cat_boundaries[idx + 1]
+    words = tree.cat_threshold[lo:hi]
+    for w, word in enumerate(words):
+        for b in range(32):
+            if int(word) >> b & 1:
+                return w * 32 + b
+    return 0
+
+
+def model_to_pmml(booster) -> str:
+    """Booster (or GBDT) -> PMML document string."""
+    inner = getattr(booster, "_inner", booster)
+    if inner.num_tree_per_iteration > 1:
+        raise ValueError(
+            "PMML export supports regression/binary models only "
+            "(reference pmml/pmml.py has the same restriction)")
+    feature_names = list(inner.feature_names)
+    out: List[str] = []
+    uid = itertools.count()
+    out.append('<PMML version="4.2" xmlns="http://www.dmg.org/PMML-4_2">')
+    out.append('\t<Header copyright="lightgbm_tpu"/>')
+    out.append("\t<DataDictionary>")
+    for name in feature_names:
+        out.append(f'\t\t<DataField name="{name}" optype="continuous" '
+                   'dataType="double"/>')
+    out.append("\t</DataDictionary>")
+    out.append('\t<MiningModel functionName="regression">')
+    out.append("\t\t<MiningSchema>")
+    for name in feature_names:
+        out.append(f'\t\t\t<MiningField name="{name}"/>')
+    out.append("\t\t</MiningSchema>")
+    out.append('\t\t<Segmentation multipleModelMethod="sum">')
+    for i, tree in enumerate(inner.models):
+        out.append(f'\t\t\t<Segment id="{i}">')
+        out.append("\t\t\t\t<True/>")
+        _tree_nodes(tree, feature_names, out, uid, 4)
+        out.append("\t\t\t</Segment>")
+    out.append("\t\t</Segmentation>")
+    out.append("\t</MiningModel>")
+    out.append("</PMML>")
+    return "\n".join(out) + "\n"
+
+
+def main(argv=None) -> int:
+    import sys
+    argv = argv if argv is not None else sys.argv[1:]
+    if len(argv) != 1:
+        print("usage: python -m lightgbm_tpu.io.pmml <model.txt>",
+              file=sys.stderr)
+        return 2
+    from ..basic import Booster
+    booster = Booster(model_file=argv[0])
+    sys.stdout.write(model_to_pmml(booster))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -248,3 +248,48 @@ def predict_forest_raw(stacked: DeviceTree, data: jnp.ndarray) -> jnp.ndarray:
     init = jnp.zeros(data.shape[0], jnp.float32)
     out, _ = jax.lax.scan(body, init, stacked)
     return out
+
+
+def predict_forest_raw_early_stop(stacked_kt: DeviceTree, data: jnp.ndarray,
+                                  margin: float, freq: int) -> jnp.ndarray:
+    """Per-row margin-based prediction early stop
+    (reference: prediction_early_stop.cpp:22-68 + the round-period loop in
+    GBDT::PredictRaw, gbdt_prediction.cpp:9-27).
+
+    stacked_kt: DeviceTree whose leaves have leading dims [K, T] — K =
+    num_tree_per_iteration (classes), T = iterations. A `lax.while_loop`
+    walks iterations; rows whose margin exceeded the threshold at the last
+    period check are frozen (their partial sum is the final answer, exactly
+    the reference semantics), and the loop exits outright once EVERY row is
+    frozen — the TPU-shaped version of the reference's per-row break.
+
+    Margins: K == 1 -> 2*|pred| (binary); K >= 2 -> top1 - top2
+    (multiclass). Returns [K, N] raw scores."""
+    k, t_total = stacked_kt.split_feature.shape[:2]
+    n = data.shape[0]
+
+    def cond(st):
+        t, _, active = st
+        return (t < t_total) & jnp.any(active)
+
+    def body(st):
+        t, acc, active = st
+        trees_t = jax.tree.map(lambda a: a[:, t], stacked_kt)
+        preds = jax.vmap(lambda tr: predict_value_raw(tr, data))(trees_t)
+        acc = acc + jnp.where(active[None, :], preds, 0.0)
+        t = t + 1
+
+        def check(a):
+            if k == 1:
+                m = 2.0 * jnp.abs(acc[0])
+            else:
+                top2 = jax.lax.top_k(acc.T, 2)[0]
+                m = top2[:, 0] - top2[:, 1]
+            return a & (m <= margin)
+
+        active = jax.lax.cond(t % freq == 0, check, lambda a: a, active)
+        return (t, acc, active)
+
+    init = (jnp.int32(0), jnp.zeros((k, n), jnp.float32), jnp.ones(n, bool))
+    _, acc, _ = jax.lax.while_loop(cond, body, init)
+    return acc

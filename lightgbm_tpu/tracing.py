@@ -1,0 +1,97 @@
+"""Per-phase timers + profiler hooks (SURVEY.md §5.1).
+
+TPU-native equivalent of the reference's compile-time TIMETAG accumulators
+(`gbdt.cpp:22-30,53-62`, `serial_tree_learner.cpp:10-17,29-37`): named
+wall-clock accumulators around the boosting phases, dumped on demand or at
+interpreter exit when `LGBM_TPU_TIMETAG=1`. Device work is asynchronous
+under JAX, so phases that must attribute device time call `block()` on
+their outputs (only when timing is enabled — timers are zero-cost when
+off).
+
+For kernel-level traces, `trace_to(dir)` wraps `jax.profiler.trace`; the
+resulting xplane protobuf is the artifact to inspect with
+`jax.profiler.ProfileData` (see scripts/profile_train.py).
+"""
+from __future__ import annotations
+
+import atexit
+import contextlib
+import os
+import time
+from collections import defaultdict
+from typing import Dict, Tuple
+
+from . import log
+
+_totals: Dict[str, float] = defaultdict(float)
+_counts: Dict[str, int] = defaultdict(int)
+_enabled = os.environ.get("LGBM_TPU_TIMETAG", "") not in ("", "0", "false")
+
+
+def enable(on: bool = True) -> None:
+    global _enabled
+    _enabled = on
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def reset() -> None:
+    _totals.clear()
+    _counts.clear()
+
+
+def totals() -> Dict[str, Tuple[float, int]]:
+    return {k: (_totals[k], _counts[k]) for k in _totals}
+
+
+@contextlib.contextmanager
+def phase(name: str, block=None):
+    """Accumulate wall time under `name`. `block` is an optional array (or
+    pytree) to block_until_ready on before stopping the clock, so async
+    device work is charged to the right phase."""
+    if not _enabled:
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        if block is not None:
+            import jax
+            jax.block_until_ready(block)
+        _totals[name] += time.perf_counter() - t0
+        _counts[name] += 1
+
+
+def block(x):
+    """Block on device values inside an open phase (when enabled)."""
+    if _enabled and x is not None:
+        import jax
+        jax.block_until_ready(x)
+    return x
+
+
+def dump() -> None:
+    """Log accumulated phase times (reference: the TIMETAG destructor
+    printout, gbdt.cpp:53-62)."""
+    if not _totals:
+        return
+    log.info("=== phase timers ===")
+    for name in sorted(_totals, key=_totals.get, reverse=True):
+        log.info("%-28s %8.3f s  x%d", name, _totals[name], _counts[name])
+
+
+@contextlib.contextmanager
+def trace_to(trace_dir: str):
+    """jax.profiler trace wrapper; writes an xplane.pb artifact."""
+    import jax
+    with jax.profiler.trace(trace_dir):
+        yield
+
+
+@atexit.register
+def _dump_at_exit() -> None:
+    if _enabled:
+        dump()

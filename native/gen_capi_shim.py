@@ -1,0 +1,279 @@
+"""Generate native/capi_shim.c — the C-ABI shared library for the LGBM_*
+surface (reference: include/LightGBM/c_api.h:38-733).
+
+Each exported symbol matches the reference prototype exactly, acquires the
+GIL (initializing an embedded interpreter if the host process has none),
+and forwards its raw argument words to the same-named Python function in
+`lightgbm_tpu.capi` — where all marshaling lives. Regenerate with:
+
+    python native/gen_capi_shim.py > native/capi_shim.c
+
+Build with native/build.py (cc -shared -fPIC against libpythonX.Y).
+"""
+from __future__ import annotations
+
+# (name, return-is-int, [(c_type, arg_name), ...]); types are the exact
+# reference prototypes (c_api.h) so the ABI matches for external callers
+FUNCS = [
+    ("LGBM_DatasetCreateFromFile",
+     [("const char*", "filename"), ("const char*", "parameters"),
+      ("const void*", "reference"), ("void**", "out")]),
+    ("LGBM_DatasetCreateFromMat",
+     [("const void*", "data"), ("int", "data_type"), ("int32_t", "nrow"),
+      ("int32_t", "ncol"), ("int", "is_row_major"),
+      ("const char*", "parameters"), ("const void*", "reference"),
+      ("void**", "out")]),
+    ("LGBM_DatasetCreateFromCSR",
+     [("const void*", "indptr"), ("int", "indptr_type"),
+      ("const int32_t*", "indices"), ("const void*", "data"),
+      ("int", "data_type"), ("int64_t", "nindptr"), ("int64_t", "nelem"),
+      ("int64_t", "num_col"), ("const char*", "parameters"),
+      ("const void*", "reference"), ("void**", "out")]),
+    ("LGBM_DatasetCreateFromCSC",
+     [("const void*", "col_ptr"), ("int", "col_ptr_type"),
+      ("const int32_t*", "indices"), ("const void*", "data"),
+      ("int", "data_type"), ("int64_t", "ncol_ptr"), ("int64_t", "nelem"),
+      ("int64_t", "num_row"), ("const char*", "parameters"),
+      ("const void*", "reference"), ("void**", "out")]),
+    ("LGBM_DatasetGetSubset",
+     [("const void*", "handle"), ("const int32_t*", "used_row_indices"),
+      ("int32_t", "num_used_row_indices"), ("const char*", "parameters"),
+      ("void**", "out")]),
+    ("LGBM_DatasetSetFeatureNames",
+     [("void*", "handle"), ("const char**", "feature_names"),
+      ("int", "num_feature_names")]),
+    ("LGBM_DatasetGetFeatureNames",
+     [("void*", "handle"), ("char**", "out_strs"), ("int*", "out_len")]),
+    ("LGBM_DatasetFree", [("void*", "handle")]),
+    ("LGBM_DatasetSaveBinary",
+     [("void*", "handle"), ("const char*", "filename")]),
+    ("LGBM_DatasetSetField",
+     [("void*", "handle"), ("const char*", "field_name"),
+      ("const void*", "field_data"), ("int", "num_element"), ("int", "type")]),
+    ("LGBM_DatasetGetField",
+     [("void*", "handle"), ("const char*", "field_name"), ("int*", "out_len"),
+      ("const void**", "out_ptr"), ("int*", "out_type")]),
+    ("LGBM_DatasetGetNumData", [("void*", "handle"), ("int*", "out")]),
+    ("LGBM_DatasetGetNumFeature", [("void*", "handle"), ("int*", "out")]),
+    ("LGBM_BoosterCreate",
+     [("const void*", "train_data"), ("const char*", "parameters"),
+      ("void**", "out")]),
+    ("LGBM_BoosterCreateFromModelfile",
+     [("const char*", "filename"), ("int*", "out_num_iterations"),
+      ("void**", "out")]),
+    ("LGBM_BoosterLoadModelFromString",
+     [("const char*", "model_str"), ("int*", "out_num_iterations"),
+      ("void**", "out")]),
+    ("LGBM_BoosterFree", [("void*", "handle")]),
+    ("LGBM_BoosterMerge", [("void*", "handle"), ("void*", "other_handle")]),
+    ("LGBM_BoosterAddValidData",
+     [("void*", "handle"), ("const void*", "valid_data")]),
+    ("LGBM_BoosterResetParameter",
+     [("void*", "handle"), ("const char*", "parameters")]),
+    ("LGBM_BoosterGetNumClasses", [("void*", "handle"), ("int*", "out_len")]),
+    ("LGBM_BoosterUpdateOneIter",
+     [("void*", "handle"), ("int*", "is_finished")]),
+    ("LGBM_BoosterUpdateOneIterCustom",
+     [("void*", "handle"), ("const float*", "grad"), ("const float*", "hess"),
+      ("int*", "is_finished")]),
+    ("LGBM_BoosterRollbackOneIter", [("void*", "handle")]),
+    ("LGBM_BoosterGetCurrentIteration",
+     [("void*", "handle"), ("int*", "out_iteration")]),
+    ("LGBM_BoosterGetEvalCounts", [("void*", "handle"), ("int*", "out_len")]),
+    ("LGBM_BoosterGetEvalNames",
+     [("void*", "handle"), ("int*", "out_len"), ("char**", "out_strs")]),
+    ("LGBM_BoosterGetFeatureNames",
+     [("void*", "handle"), ("int*", "out_len"), ("char**", "out_strs")]),
+    ("LGBM_BoosterGetNumFeature", [("void*", "handle"), ("int*", "out_len")]),
+    ("LGBM_BoosterGetEval",
+     [("void*", "handle"), ("int", "data_idx"), ("int*", "out_len"),
+      ("double*", "out_results")]),
+    ("LGBM_BoosterPredictForFile",
+     [("void*", "handle"), ("const char*", "data_filename"),
+      ("int", "data_has_header"), ("int", "predict_type"),
+      ("int", "num_iteration"), ("const char*", "result_filename")]),
+    ("LGBM_BoosterCalcNumPredict",
+     [("void*", "handle"), ("int", "num_row"), ("int", "predict_type"),
+      ("int", "num_iteration"), ("int64_t*", "out_len")]),
+    ("LGBM_BoosterPredictForCSR",
+     [("void*", "handle"), ("const void*", "indptr"), ("int", "indptr_type"),
+      ("const int32_t*", "indices"), ("const void*", "data"),
+      ("int", "data_type"), ("int64_t", "nindptr"), ("int64_t", "nelem"),
+      ("int64_t", "num_col"), ("int", "predict_type"),
+      ("int", "num_iteration"), ("int64_t*", "out_len"),
+      ("double*", "out_result")]),
+    ("LGBM_BoosterPredictForCSC",
+     [("void*", "handle"), ("const void*", "col_ptr"), ("int", "col_ptr_type"),
+      ("const int32_t*", "indices"), ("const void*", "data"),
+      ("int", "data_type"), ("int64_t", "ncol_ptr"), ("int64_t", "nelem"),
+      ("int64_t", "num_row"), ("int", "predict_type"),
+      ("int", "num_iteration"), ("int64_t*", "out_len"),
+      ("double*", "out_result")]),
+    ("LGBM_BoosterPredictForMat",
+     [("void*", "handle"), ("const void*", "data"), ("int", "data_type"),
+      ("int32_t", "nrow"), ("int32_t", "ncol"), ("int", "is_row_major"),
+      ("int", "predict_type"), ("int", "num_iteration"),
+      ("int64_t*", "out_len"), ("double*", "out_result")]),
+    ("LGBM_BoosterSaveModel",
+     [("void*", "handle"), ("int", "num_iteration"),
+      ("const char*", "filename")]),
+    ("LGBM_BoosterSaveModelToString",
+     [("void*", "handle"), ("int", "num_iteration"), ("int64_t", "buffer_len"),
+      ("int64_t*", "out_len"), ("char*", "out_str")]),
+    ("LGBM_BoosterDumpModel",
+     [("void*", "handle"), ("int", "num_iteration"), ("int64_t", "buffer_len"),
+      ("int64_t*", "out_len"), ("char*", "out_str")]),
+    ("LGBM_BoosterGetLeafValue",
+     [("void*", "handle"), ("int", "tree_idx"), ("int", "leaf_idx"),
+      ("double*", "out_val")]),
+    ("LGBM_BoosterSetLeafValue",
+     [("void*", "handle"), ("int", "tree_idx"), ("int", "leaf_idx"),
+      ("double", "val")]),
+    ("LGBM_BoosterFeatureImportance",
+     [("void*", "handle"), ("int", "num_iteration"),
+      ("double*", "out_results")]),
+]
+
+HEADER = r'''/* Generated by native/gen_capi_shim.py — DO NOT EDIT BY HAND.
+ *
+ * C ABI for the lightgbm_tpu LGBM_* surface (prototypes mirror the
+ * reference include/LightGBM/c_api.h). Every call acquires the GIL —
+ * initializing an embedded interpreter when the host process has none —
+ * and forwards raw argument words to lightgbm_tpu.capi, which owns all
+ * pointer marshaling.
+ */
+#include <Python.h>
+#include <stdint.h>
+#include <string.h>
+
+#define LGBM_EXPORT __attribute__((visibility("default")))
+
+static char last_error_buf[4096] = "everything is fine";
+
+static PyObject* capi_module(void) {
+    static PyObject* mod = NULL;
+    if (mod == NULL) {
+        mod = PyImport_ImportModule("lightgbm_tpu.capi");
+    }
+    return mod;
+}
+
+static void ensure_python(void) {
+    if (!Py_IsInitialized()) {
+        Py_InitializeEx(0);
+    }
+}
+
+/* forward nargs 64-bit words (pointers and integers) plus an optional
+   trailing double to capi.<name>; returns the int rc, -1 on failure */
+static int forward_call(const char* name, int nargs,
+                        const long long* words, int ndoubles,
+                        const double* doubles) {
+    PyGILState_STATE gil;
+    PyObject *mod, *fn, *args, *res;
+    int rc = -1, i;
+    ensure_python();
+    gil = PyGILState_Ensure();
+    mod = capi_module();
+    if (mod == NULL) goto error;
+    fn = PyObject_GetAttrString(mod, name);
+    if (fn == NULL) goto error;
+    args = PyTuple_New(nargs + ndoubles);
+    for (i = 0; i < nargs; i++) {
+        PyTuple_SET_ITEM(args, i, PyLong_FromLongLong(words[i]));
+    }
+    for (i = 0; i < ndoubles; i++) {
+        PyTuple_SET_ITEM(args, nargs + i, PyFloat_FromDouble(doubles[i]));
+    }
+    res = PyObject_CallObject(fn, args);
+    Py_DECREF(args);
+    Py_DECREF(fn);
+    if (res == NULL) goto error;
+    rc = (int)PyLong_AsLong(res);
+    Py_DECREF(res);
+    PyGILState_Release(gil);
+    return rc;
+error:
+    if (PyErr_Occurred()) {
+        PyObject *etype, *eval, *etb, *s;
+        PyErr_Fetch(&etype, &eval, &etb);
+        s = eval ? PyObject_Str(eval) : NULL;
+        if (s != NULL) {
+            const char* msg = PyUnicode_AsUTF8(s);
+            if (msg != NULL) {
+                strncpy(last_error_buf, msg, sizeof(last_error_buf) - 1);
+            }
+            Py_DECREF(s);
+        }
+        Py_XDECREF(etype); Py_XDECREF(eval); Py_XDECREF(etb);
+    }
+    PyGILState_Release(gil);
+    return -1;
+}
+
+LGBM_EXPORT const char* LGBM_GetLastError(void) {
+    PyGILState_STATE gil;
+    PyObject *mod, *fn, *res;
+    ensure_python();
+    gil = PyGILState_Ensure();
+    mod = capi_module();
+    if (mod != NULL) {
+        fn = PyObject_GetAttrString(mod, "LGBM_GetLastError");
+        if (fn != NULL) {
+            res = PyObject_CallObject(fn, NULL);
+            if (res != NULL) {
+                const char* msg = PyUnicode_AsUTF8(res);
+                if (msg != NULL) {
+                    strncpy(last_error_buf, msg,
+                            sizeof(last_error_buf) - 1);
+                }
+                Py_DECREF(res);
+            }
+            Py_DECREF(fn);
+        }
+    }
+    PyErr_Clear();
+    PyGILState_Release(gil);
+    return last_error_buf;
+}
+'''
+
+
+def emit_fn(name, args) -> str:
+    sig = ", ".join(f"{t} {a}" for t, a in args) or "void"
+    words, doubles = [], []
+    for t, a in args:
+        if t == "double":
+            doubles.append(a)
+        elif "*" in t:
+            words.append(f"(long long)(intptr_t){a}")
+        else:
+            words.append(f"(long long){a}")
+    lines = [f"LGBM_EXPORT int {name}({sig}) {{"]
+    if words:
+        lines.append(f"    long long w[{len(words)}] = {{"
+                     + ", ".join(words) + "};")
+    else:
+        lines.append("    long long* w = NULL;")
+    if doubles:
+        lines.append(f"    double d[{len(doubles)}] = {{"
+                     + ", ".join(doubles) + "};")
+        dref = "d"
+    else:
+        dref = "NULL"
+    wref = "w" if words else "NULL"
+    lines.append(f'    return forward_call("{name}", {len(words)}, {wref}, '
+                 f"{len(doubles)}, {dref});")
+    lines.append("}")
+    return "\n".join(lines)
+
+
+def main() -> str:
+    parts = [HEADER]
+    for name, args in FUNCS:
+        parts.append(emit_fn(name, args))
+    return "\n\n".join(parts) + "\n"
+
+
+if __name__ == "__main__":
+    print(main(), end="")

@@ -1,0 +1,124 @@
+// Fast dense TSV/CSV numeric parser (reference: src/io/parser.cpp:1-258 —
+// the CSVParser/TSVParser hot loops). Loaded via ctypes by
+// lightgbm_tpu/io/parser.py; the Python numpy path remains the fallback.
+//
+// Single pass over a memory-buffered file with strtod; missing tokens
+// ("", "na", "nan", "null", "?") parse to NaN, matching the Python
+// loader's NA token set.
+#include <locale.h>
+
+#include <cctype>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+bool is_na_token(const char* s, size_t len) {
+  if (len == 0) return true;
+  if (len > 4) return false;
+  char buf[5];
+  for (size_t i = 0; i < len; ++i) buf[i] = std::tolower(s[i]);
+  buf[len] = 0;
+  return !strcmp(buf, "na") || !strcmp(buf, "nan") || !strcmp(buf, "null") ||
+         !strcmp(buf, "none") || !strcmp(buf, "?");
+}
+
+}  // namespace
+
+extern "C" {
+
+// Parse a delimited numeric file. On success returns 0 and sets
+// *out_rows/*out_cols and *out_data (malloc'd row-major doubles; release
+// with lgbm_tpu_free). Ragged input (rows with differing column counts)
+// returns -2 so the caller can fall back to the Python path, which raises
+// a proper error — silent NaN-padding would corrupt data.
+int lgbm_tpu_parse_dense(const char* path, char delim, int skip_header,
+                         int64_t* out_rows, int64_t* out_cols,
+                         double** out_data) {
+  // strtod is locale-sensitive; parse under the C locale so "1.5" means
+  // the same thing regardless of the embedding application's LC_NUMERIC
+  static locale_t c_locale = newlocale(LC_ALL_MASK, "C", (locale_t)0);
+  FILE* f = std::fopen(path, "rb");
+  if (f == nullptr) return -1;
+  std::fseek(f, 0, SEEK_END);
+  long size = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  std::string buf(static_cast<size_t>(size), 0);
+  if (size > 0 && std::fread(&buf[0], 1, size, f) != (size_t)size) {
+    std::fclose(f);
+    return -1;
+  }
+  std::fclose(f);
+
+  std::vector<double> values;
+  values.reserve(1 << 20);
+  std::vector<int64_t> row_starts;
+  int64_t max_cols = -1;
+
+  const char* p = buf.data();
+  const char* end = p + buf.size();
+  bool first_line = true;
+  while (p < end) {
+    const char* line_end = static_cast<const char*>(
+        memchr(p, '\n', static_cast<size_t>(end - p)));
+    if (line_end == nullptr) line_end = end;
+    const char* le = line_end;
+    while (le > p && (le[-1] == '\r' || le[-1] == ' ')) --le;
+    if (first_line && skip_header) {
+      first_line = false;
+      p = line_end + 1;
+      continue;
+    }
+    first_line = false;
+    if (le > p) {
+      row_starts.push_back(static_cast<int64_t>(values.size()));
+      const char* tok = p;
+      int64_t cols = 0;
+      while (tok <= le) {
+        const char* tok_end = static_cast<const char*>(
+            memchr(tok, delim, static_cast<size_t>(le - tok)));
+        if (tok_end == nullptr) tok_end = le;
+        size_t len = static_cast<size_t>(tok_end - tok);
+        if (is_na_token(tok, len)) {
+          values.push_back(std::nan(""));
+        } else {
+          char* conv_end = nullptr;
+          double v = strtod_l(tok, &conv_end, c_locale);
+          values.push_back(conv_end == tok ? std::nan("") : v);
+        }
+        ++cols;
+        if (tok_end >= le) break;
+        tok = tok_end + 1;
+      }
+      if (max_cols < 0) {
+        max_cols = cols;
+      } else if (cols != max_cols) {
+        return -2;  // ragged input: let the Python path raise
+      }
+    }
+    p = line_end + 1;
+  }
+  if (max_cols < 0) max_cols = 0;
+
+  int64_t rows = static_cast<int64_t>(row_starts.size());
+  double* out = static_cast<double*>(
+      std::malloc(sizeof(double) * static_cast<size_t>(rows * max_cols)));
+  if (out == nullptr && rows * max_cols > 0) return -1;
+  if (rows * max_cols > 0) {
+    std::memcpy(out, values.data(),
+                sizeof(double) * static_cast<size_t>(rows * max_cols));
+  }
+  *out_rows = rows;
+  *out_cols = max_cols;
+  *out_data = out;
+  return 0;
+}
+
+void lgbm_tpu_free(double* ptr) { std::free(ptr); }
+
+}  // extern "C"

@@ -1,0 +1,286 @@
+"""C API tests — mirrors the reference's ctypes-driven smoke test
+(`tests/c_api_test/test_.py:198-255`): dataset from mat/CSR/file, field
+get/set, booster train loop, eval, predict, model save/load round-trip.
+
+Calls the `LGBM_*` functions with REAL ctypes pointers, exercising the
+same marshaling the C shim (native/capi_shim.c) forwards."""
+import ctypes
+import os
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu import capi
+
+
+def _vp():
+    return ctypes.c_void_p(0)
+
+
+def _make_mat(n=200, f=5, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f).astype(np.float64)
+    y = (X[:, 0] + X[:, 1] > 0).astype(np.float32)
+    return np.ascontiguousarray(X), y
+
+
+def _dataset_from_mat(X, y, params=b"max_bin=31", ref=None):
+    h = _vp()
+    rc = capi.LGBM_DatasetCreateFromMat(
+        X.ctypes.data, capi.C_API_DTYPE_FLOAT64, X.shape[0], X.shape[1],
+        1, ctypes.c_char_p(params), ref.value if ref else 0,
+        ctypes.addressof(h))
+    assert rc == 0, capi.LGBM_GetLastError()
+    rc = capi.LGBM_DatasetSetField(
+        h, ctypes.c_char_p(b"label"), y.ctypes.data, len(y),
+        capi.C_API_DTYPE_FLOAT32)
+    assert rc == 0, capi.LGBM_GetLastError()
+    return h
+
+
+def test_dataset_create_get_free():
+    X, y = _make_mat()
+    h = _dataset_from_mat(X, y)
+    out = ctypes.c_int(0)
+    assert capi.LGBM_DatasetGetNumData(h, ctypes.addressof(out)) == 0
+    assert out.value == 200
+    assert capi.LGBM_DatasetGetNumFeature(h, ctypes.addressof(out)) == 0
+    assert out.value == 5
+
+    # GetField returns a borrowed pointer onto the stored label
+    out_len = ctypes.c_int(0)
+    out_ptr = ctypes.c_void_p(0)
+    out_type = ctypes.c_int(-1)
+    rc = capi.LGBM_DatasetGetField(
+        h, ctypes.c_char_p(b"label"), ctypes.addressof(out_len),
+        ctypes.addressof(out_ptr), ctypes.addressof(out_type))
+    assert rc == 0, capi.LGBM_GetLastError()
+    assert out_len.value == 200
+    assert out_type.value == capi.C_API_DTYPE_FLOAT32
+    lab = np.ctypeslib.as_array(
+        ctypes.cast(out_ptr, ctypes.POINTER(ctypes.c_float)), shape=(200,))
+    np.testing.assert_allclose(lab, y)
+    assert capi.LGBM_DatasetFree(h) == 0
+    # double free reports an error through LGBM_GetLastError
+    assert capi.LGBM_DatasetFree(h) == -1
+    assert "Invalid handle" in capi.LGBM_GetLastError()
+
+
+def test_dataset_from_csr_matches_mat():
+    X, y = _make_mat(100, 4, seed=1)
+    X[np.abs(X) < 0.6] = 0.0  # sparsify
+    from scipy import sparse as sp  # scipy is available via sklearn dep
+    csr = sp.csr_matrix(X)
+    h = _vp()
+    indptr = csr.indptr.astype(np.int32)
+    indices = csr.indices.astype(np.int32)
+    vals = csr.data.astype(np.float64)
+    rc = capi.LGBM_DatasetCreateFromCSR(
+        indptr.ctypes.data, capi.C_API_DTYPE_INT32, indices.ctypes.data,
+        vals.ctypes.data, capi.C_API_DTYPE_FLOAT64, len(indptr), len(vals),
+        X.shape[1], ctypes.c_char_p(b""), 0, ctypes.addressof(h))
+    assert rc == 0, capi.LGBM_GetLastError()
+    out = ctypes.c_int(0)
+    capi.LGBM_DatasetGetNumData(h, ctypes.addressof(out))
+    assert out.value == 100
+    capi.LGBM_DatasetFree(h)
+
+
+def test_booster_train_eval_predict_roundtrip(tmp_path):
+    X, y = _make_mat(300, 5)
+    h_train = _dataset_from_mat(X, y, b"max_bin=63 num_leaves=15")
+    bh = _vp()
+    rc = capi.LGBM_BoosterCreate(
+        h_train, ctypes.c_char_p(b"objective=binary metric=binary_logloss "
+                                 b"num_leaves=15 verbose=-1"),
+        ctypes.addressof(bh))
+    assert rc == 0, capi.LGBM_GetLastError()
+
+    fin = ctypes.c_int(0)
+    for _ in range(10):
+        assert capi.LGBM_BoosterUpdateOneIter(bh, ctypes.addressof(fin)) == 0
+
+    it = ctypes.c_int(0)
+    assert capi.LGBM_BoosterGetCurrentIteration(bh, ctypes.addressof(it)) == 0
+    assert it.value == 10
+
+    cnt = ctypes.c_int(0)
+    assert capi.LGBM_BoosterGetEvalCounts(bh, ctypes.addressof(cnt)) == 0
+    assert cnt.value >= 1
+    res = (ctypes.c_double * cnt.value)()
+    out_len = ctypes.c_int(0)
+    assert capi.LGBM_BoosterGetEval(bh, 0, ctypes.addressof(out_len),
+                                    ctypes.addressof(res)) == 0
+    assert out_len.value == cnt.value
+    assert res[0] < 0.5  # logloss after 10 iters
+
+    # predict for mat
+    out_cnt = ctypes.c_int64(0)
+    assert capi.LGBM_BoosterCalcNumPredict(
+        bh, X.shape[0], capi.C_API_PREDICT_NORMAL, -1,
+        ctypes.addressof(out_cnt)) == 0
+    assert out_cnt.value == X.shape[0]
+    preds = (ctypes.c_double * X.shape[0])()
+    plen = ctypes.c_int64(0)
+    rc = capi.LGBM_BoosterPredictForMat(
+        bh, X.ctypes.data, capi.C_API_DTYPE_FLOAT64, X.shape[0], X.shape[1],
+        1, capi.C_API_PREDICT_NORMAL, -1, ctypes.addressof(plen),
+        ctypes.addressof(preds))
+    assert rc == 0, capi.LGBM_GetLastError()
+    p = np.ctypeslib.as_array(preds)
+    acc = np.mean((p > 0.5) == y)
+    assert acc > 0.9
+
+    # model text round-trip through the string API
+    blen = ctypes.c_int64(0)
+    buf = ctypes.create_string_buffer(1 << 20)
+    rc = capi.LGBM_BoosterSaveModelToString(
+        bh, -1, len(buf), ctypes.addressof(blen), ctypes.addressof(buf))
+    assert rc == 0 and 0 < blen.value <= len(buf)
+    bh2 = _vp()
+    n_iter = ctypes.c_int(0)
+    rc = capi.LGBM_BoosterLoadModelFromString(
+        ctypes.c_char_p(buf.value), ctypes.addressof(n_iter),
+        ctypes.addressof(bh2))
+    assert rc == 0, capi.LGBM_GetLastError()
+    assert n_iter.value == 10
+    preds2 = (ctypes.c_double * X.shape[0])()
+    capi.LGBM_BoosterPredictForMat(
+        bh2, X.ctypes.data, capi.C_API_DTYPE_FLOAT64, X.shape[0], X.shape[1],
+        1, capi.C_API_PREDICT_NORMAL, -1, ctypes.addressof(plen),
+        ctypes.addressof(preds2))
+    np.testing.assert_allclose(np.ctypeslib.as_array(preds2), p, rtol=1e-6)
+
+    # save to file + create from model file
+    mpath = str(tmp_path / "capi_model.txt")
+    assert capi.LGBM_BoosterSaveModel(
+        bh, -1, ctypes.c_char_p(mpath.encode())) == 0
+    bh3 = _vp()
+    assert capi.LGBM_BoosterCreateFromModelfile(
+        ctypes.c_char_p(mpath.encode()), ctypes.addressof(n_iter),
+        ctypes.addressof(bh3)) == 0
+    assert n_iter.value == 10
+
+    # feature importance
+    imp = (ctypes.c_double * X.shape[1])()
+    assert capi.LGBM_BoosterFeatureImportance(
+        bh, -1, ctypes.addressof(imp)) == 0
+    assert sum(imp) > 0
+
+    for handle in (bh, bh2, bh3):
+        capi.LGBM_BoosterFree(handle)
+    capi.LGBM_DatasetFree(h_train)
+
+
+def test_booster_custom_objective_update():
+    X, y = _make_mat(200, 4, seed=2)
+    h = _dataset_from_mat(X, y)
+    bh = _vp()
+    assert capi.LGBM_BoosterCreate(
+        h, ctypes.c_char_p(b"objective=none num_leaves=7 verbose=-1"),
+        ctypes.addressof(bh)) == 0, capi.LGBM_GetLastError()
+    fin = ctypes.c_int(0)
+    score = np.zeros(200, np.float64)
+    for _ in range(5):
+        p = 1.0 / (1.0 + np.exp(-score))
+        grad = (p - y).astype(np.float32)
+        hess = (p * (1 - p)).astype(np.float32)
+        rc = capi.LGBM_BoosterUpdateOneIterCustom(
+            bh, grad.ctypes.data, hess.ctypes.data, ctypes.addressof(fin))
+        assert rc == 0, capi.LGBM_GetLastError()
+        preds = (ctypes.c_double * 200)()
+        plen = ctypes.c_int64(0)
+        capi.LGBM_BoosterPredictForMat(
+            bh, X.ctypes.data, capi.C_API_DTYPE_FLOAT64, 200, 4, 1,
+            capi.C_API_PREDICT_RAW_SCORE, -1, ctypes.addressof(plen),
+            ctypes.addressof(preds))
+        score = np.ctypeslib.as_array(preds).copy()
+    acc = np.mean((score > 0) == y)
+    assert acc > 0.85
+    capi.LGBM_BoosterFree(bh)
+    capi.LGBM_DatasetFree(h)
+
+
+def test_dataset_from_file_and_predict_for_file(tmp_path):
+    X, y = _make_mat(150, 4, seed=3)
+    path = str(tmp_path / "capi_train.tsv")
+    np.savetxt(path, np.column_stack([y, X]), delimiter="\t", fmt="%.6f")
+    h = _vp()
+    rc = capi.LGBM_DatasetCreateFromFile(
+        ctypes.c_char_p(path.encode()), ctypes.c_char_p(b"max_bin=31"), 0,
+        ctypes.addressof(h))
+    assert rc == 0, capi.LGBM_GetLastError()
+    out = ctypes.c_int(0)
+    capi.LGBM_DatasetGetNumData(h, ctypes.addressof(out))
+    assert out.value == 150
+
+    bh = _vp()
+    assert capi.LGBM_BoosterCreate(
+        h, ctypes.c_char_p(b"objective=binary verbose=-1 num_leaves=7"),
+        ctypes.addressof(bh)) == 0
+    fin = ctypes.c_int(0)
+    for _ in range(5):
+        capi.LGBM_BoosterUpdateOneIter(bh, ctypes.addressof(fin))
+    rpath = str(tmp_path / "capi_preds.txt")
+    rc = capi.LGBM_BoosterPredictForFile(
+        bh, ctypes.c_char_p(path.encode()), 0, capi.C_API_PREDICT_NORMAL,
+        -1, ctypes.c_char_p(rpath.encode()))
+    assert rc == 0, capi.LGBM_GetLastError()
+    preds = np.loadtxt(rpath)
+    assert preds.shape == (150,)
+    assert np.mean((preds > 0.5) == y) > 0.85
+    capi.LGBM_BoosterFree(bh)
+    capi.LGBM_DatasetFree(h)
+
+
+def test_c_abi_shim(tmp_path):
+    """Build (if needed) and drive the real C shared library
+    (native/capi_shim.c) through ctypes — the exact path an external
+    (non-Python) binding takes."""
+    import subprocess
+    import sys
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    so = os.path.join(root, "native", "lib_lightgbm_tpu.so")
+    if not os.path.exists(so):
+        try:
+            subprocess.run([sys.executable,
+                            os.path.join(root, "native", "build.py")],
+                           check=True, capture_output=True, timeout=120)
+        except (subprocess.CalledProcessError, FileNotFoundError,
+                subprocess.TimeoutExpired) as e:
+            pytest.skip(f"cannot build C shim: {e}")
+    lib = ctypes.CDLL(so)
+    lib.LGBM_GetLastError.restype = ctypes.c_char_p
+
+    X, y = _make_mat(200, 5)
+    X = np.ascontiguousarray(X)
+    h = ctypes.c_void_p(0)
+    rc = lib.LGBM_DatasetCreateFromMat(
+        ctypes.c_void_p(X.ctypes.data), capi.C_API_DTYPE_FLOAT64, 200, 5, 1,
+        ctypes.c_char_p(b"max_bin=63"), ctypes.c_void_p(0), ctypes.byref(h))
+    assert rc == 0, lib.LGBM_GetLastError()
+    assert lib.LGBM_DatasetSetField(
+        h, ctypes.c_char_p(b"label"), ctypes.c_void_p(y.ctypes.data), 200,
+        capi.C_API_DTYPE_FLOAT32) == 0
+    bh = ctypes.c_void_p(0)
+    assert lib.LGBM_BoosterCreate(
+        h, ctypes.c_char_p(b"objective=binary verbose=-1 num_leaves=15"),
+        ctypes.byref(bh)) == 0, lib.LGBM_GetLastError()
+    fin = ctypes.c_int(0)
+    for _ in range(10):
+        assert lib.LGBM_BoosterUpdateOneIter(bh, ctypes.byref(fin)) == 0
+    preds = (ctypes.c_double * 200)()
+    plen = ctypes.c_int64(0)
+    assert lib.LGBM_BoosterPredictForMat(
+        bh, ctypes.c_void_p(X.ctypes.data), capi.C_API_DTYPE_FLOAT64,
+        200, 5, 1, capi.C_API_PREDICT_NORMAL, -1, ctypes.byref(plen),
+        ctypes.byref(preds)) == 0
+    p = np.ctypeslib.as_array(preds)
+    assert np.mean((p > 0.5) == y) > 0.85
+    # error path surfaces through LGBM_GetLastError
+    bad = lib.LGBM_BoosterUpdateOneIter(ctypes.c_void_p(999999),
+                                        ctypes.byref(fin))
+    assert bad == -1
+    assert b"Invalid handle" in lib.LGBM_GetLastError()
+    lib.LGBM_BoosterFree(bh)
+    lib.LGBM_DatasetFree(h)

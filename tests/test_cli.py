@@ -99,3 +99,21 @@ def test_cli_convert_model(data_files):
     from lightgbm_tpu import Booster
     py_preds = Booster(model_file=str(model_path)).predict(X[:20], raw_score=True)
     np.testing.assert_allclose(cpp_preds, py_preds, rtol=1e-5, atol=1e-6)
+
+
+def test_cli_snapshot_freq(data_files):
+    """snapshot_freq writes periodic model snapshots during training
+    (reference: GBDT::Train, gbdt.cpp:349-353)."""
+    from lightgbm_tpu.cli import main
+    tmp_path, train_path, _ = data_files
+    model_path = tmp_path / "snap_model.txt"
+    assert main(["task=train", "objective=binary", f"data={train_path}",
+                 "num_trees=6", "num_leaves=7", "snapshot_freq=2",
+                 f"output_model={model_path}", "verbose=-1"]) == 0
+    for it in (2, 4, 6):
+        snap = f"{model_path}.snapshot_iter_{it}"
+        assert os.path.exists(snap), f"missing snapshot {snap}"
+    # a snapshot is a loadable model prefix of the final model
+    import lightgbm_tpu as lgb
+    b = lgb.Booster(model_file=f"{model_path}.snapshot_iter_2")
+    assert b.num_trees() == 2

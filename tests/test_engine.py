@@ -289,3 +289,22 @@ def test_pred_contrib(breast_cancer):
     raw = gbm.predict(X[:10], raw_score=True)
     # SHAP efficiency: contributions sum to the raw prediction
     np.testing.assert_allclose(contrib.sum(axis=1), raw, rtol=1e-4, atol=1e-4)
+
+
+def test_pred_early_stop(breast_cancer):
+    """pred_early_stop freezes rows whose margin clears the threshold
+    (reference: prediction_early_stop.cpp + gbdt_prediction.cpp:9-27)."""
+    X, y = breast_cancer
+    gbm = lgb.train({"objective": "binary", "verbose": -1},
+                    lgb.Dataset(X, y), num_boost_round=20, verbose_eval=False)
+    full = gbm.predict(X, raw_score=True)
+    # margin never reached -> identical to full prediction
+    same = gbm.predict(X, raw_score=True, pred_early_stop=True,
+                       pred_early_stop_freq=5, pred_early_stop_margin=1e30)
+    np.testing.assert_allclose(full, same, rtol=1e-6)
+    # tiny margin, freq=1 -> every row stops after the first iteration
+    stopped = gbm.predict(X, raw_score=True, pred_early_stop=True,
+                          pred_early_stop_freq=1, pred_early_stop_margin=0.0)
+    one_iter = gbm.predict(X, raw_score=True, num_iteration=1)
+    np.testing.assert_allclose(stopped, one_iter, rtol=1e-6)
+    assert not np.allclose(full, stopped)

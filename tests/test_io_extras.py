@@ -1,0 +1,74 @@
+"""IO extras: PMML exporter (reference: pmml/pmml.py) and the native
+parser fast path (native/parser.cpp) vs the Python fallback."""
+import os
+import xml.etree.ElementTree as ET
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.io.pmml import model_to_pmml
+
+NS = "{http://www.dmg.org/PMML-4_2}"
+
+
+def test_pmml_export_regression():
+    rng = np.random.RandomState(0)
+    X = rng.randn(200, 4)
+    y = X[:, 0] * 2 + X[:, 1] + 0.1 * rng.randn(200)
+    m = lgb.train({"objective": "regression", "verbose": -1,
+                   "num_leaves": 7, "min_data_in_leaf": 5},
+                  lgb.Dataset(X, y), num_boost_round=5, verbose_eval=False)
+    root = ET.fromstring(model_to_pmml(m))
+    assert len(root.findall(f".//{NS}Segment")) == 5
+    assert len(root.findall(f".//{NS}TreeModel")) == 5
+    # every internal TreeModel node carries a predicate
+    preds = root.findall(f".//{NS}SimplePredicate")
+    assert preds and all(p.get("operator") in
+                         ("lessOrEqual", "greaterThan", "equal", "notEqual")
+                         for p in preds)
+
+
+def test_pmml_rejects_multiclass():
+    rng = np.random.RandomState(1)
+    X = rng.randn(150, 4)
+    y = rng.randint(0, 3, 150)
+    m = lgb.train({"objective": "multiclass", "num_class": 3, "verbose": -1,
+                   "num_leaves": 5, "min_data_in_leaf": 5},
+                  lgb.Dataset(X, y), num_boost_round=2, verbose_eval=False)
+    with pytest.raises(ValueError):
+        model_to_pmml(m)
+
+
+def test_native_parser_matches_python(tmp_path):
+    from lightgbm_tpu.io import parser as P
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    so = os.path.join(root, "native", "parser_native.so")
+    if not os.path.exists(so):
+        import subprocess
+        import sys
+        try:
+            subprocess.run([sys.executable,
+                            os.path.join(root, "native", "build.py")],
+                           check=True, capture_output=True, timeout=120)
+        except Exception as e:  # noqa: BLE001
+            pytest.skip(f"cannot build native parser: {e}")
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(500, 6)
+    X[::7, 2] = np.nan
+    y = (X[:, 0] > 0).astype(float)
+    path = str(tmp_path / "t.tsv")
+    np.savetxt(path, np.column_stack([y, X]), delimiter="\t", fmt="%.6g")
+
+    d1, l1 = P.load_data_file(path)
+    assert P._native_lib is not None, "native parser not loaded"
+    saved = P._native_lib
+    try:
+        P._native_lib = None
+        d2, l2 = P.load_data_file(path)
+    finally:
+        P._native_lib = saved
+    np.testing.assert_allclose(np.nan_to_num(d1, nan=-9e9),
+                               np.nan_to_num(d2, nan=-9e9), rtol=1e-12)
+    np.testing.assert_allclose(l1, l2)
