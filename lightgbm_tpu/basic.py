@@ -166,8 +166,9 @@ class Dataset:
             bin_construct_sample_cnt=int(params.get("bin_construct_sample_cnt", 200000)),
             data_random_seed=int(params.get("data_random_seed", 1)),
             categorical_features=cat_indices,
-            use_missing=bool(params.get("use_missing", True)),
-            zero_as_missing=bool(params.get("zero_as_missing", False)),
+            use_missing=_parse_value(params.get("use_missing", True), bool),
+            zero_as_missing=_parse_value(
+                params.get("zero_as_missing", False), bool),
             feature_names=feature_names,
             weight=weight, group=group, init_score=init_score,
             reference=ref_inner, keep_raw=not self.free_raw_data,

@@ -162,6 +162,15 @@ def main(argv: List[str] = None) -> int:
     elif cfg.io.verbosity >= 2:
         log.set_level(log.DEBUG)
 
+    # multi-host mesh (reference: Application::InitTrain's Network::Init,
+    # application.cpp:190-224 — here jax.distributed over the machine list)
+    if cfg.network.num_machines > 1:
+        from .parallel.multihost import init_distributed
+        init_distributed(
+            num_processes=cfg.network.num_machines,
+            machine_list_filename=cfg.network.machine_list_filename,
+            local_listen_port=cfg.network.local_listen_port)
+
     task = cfg.task
     if task == "train":
         run_train(params, cfg)

@@ -198,6 +198,11 @@ class BinaryLogloss(ObjectiveFunction):
     """reference: binary_objective.hpp:13-157."""
     name = "binary"
 
+    def to_string(self):
+        # the reference loader REQUIRES the sigmoid token
+        # (binary_objective.hpp:32-42 fatals without it)
+        return f"binary sigmoid:{self.sigmoid:g}"
+
     def __init__(self, config: Config):
         self.sigmoid = config.objective_config.sigmoid
         if self.sigmoid <= 0:
@@ -250,6 +255,9 @@ class MulticlassSoftmax(ObjectiveFunction):
         if self.num_class < 2:
             log.fatal("num_class must be >= 2 for multiclass")
 
+    def to_string(self):
+        return f"multiclass num_class:{self.num_class}"
+
     def init(self, metadata, num_data):
         super().init(metadata, num_data)
         lab = np.asarray(metadata.label).astype(int)
@@ -285,6 +293,10 @@ class MulticlassSoftmax(ObjectiveFunction):
 class MulticlassOVA(ObjectiveFunction):
     """reference: multiclass_objective.hpp:139-253 (one-vs-all binary)."""
     name = "multiclassova"
+
+    def to_string(self):
+        return (f"multiclassova num_class:{self.num_class} "
+                f"sigmoid:{self.sigmoid:g}")
 
     def __init__(self, config: Config):
         self.num_class = config.objective_config.num_class

@@ -1,0 +1,294 @@
+"""Distributed / two-round data loading.
+
+Re-implements the reference DatasetLoader's scale paths
+(`src/io/dataset_loader.cpp`):
+
+- rank-sharded row loading with query atomicity — rows (or whole queries,
+  which must never straddle ranks) are assigned to machines by a seeded
+  uniform draw, the reference's random-partition mode
+  (dataset_loader.cpp:417-424, 570-600);
+- distributed bin finding — features are block-sharded across machines,
+  each machine runs FindBin only for its block, and the mappers are
+  allgathered (dataset_loader.cpp:737-817). The exchange rides a pluggable
+  `comm` (jax multihost allgather when processes > 1; loopback otherwise —
+  the in-process fake network the reference never built, SURVEY.md §4);
+- two-round loading (dataset_loader.cpp:193-207): round one samples rows
+  for bin finding, round two streams the file in chunks straight into the
+  binned uint8 matrix, never materializing the full float matrix
+  (10.5M x 28 HIGGS: 294 MB binned vs 2.4 GB of float64).
+"""
+from __future__ import annotations
+
+import json
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import log
+from ..binning import BinMapper
+
+
+def partition_rows(num_rows: int, rank: int, num_machines: int,
+                   query_boundaries: Optional[np.ndarray] = None,
+                   seed: int = 1) -> np.ndarray:
+    """Row indices owned by `rank` under the reference's random partition.
+
+    Plain rows are assigned independently; with query boundaries whole
+    QUERIES are assigned (lambdarank constraint: a query never straddles
+    machines, dataset_loader.cpp:159-166, 580-598). Deterministic in
+    `seed`, so every rank computes the same global assignment."""
+    rng = np.random.RandomState(seed)
+    if query_boundaries is None:
+        owner = rng.randint(0, num_machines, size=num_rows)
+        return np.nonzero(owner == rank)[0]
+    qb = np.asarray(query_boundaries)
+    nq = len(qb) - 1
+    owner_q = rng.randint(0, num_machines, size=nq)
+    sizes = np.diff(qb)
+    owner_row = np.repeat(owner_q, sizes)
+    return np.nonzero(owner_row == rank)[0]
+
+
+def load_partition(path: str, rank: int, num_machines: int,
+                   has_header: bool = False, seed: int = 1
+                   ) -> Tuple[np.ndarray, Optional[np.ndarray], np.ndarray, int]:
+    """Parse `path` and keep only this rank's rows.
+
+    Returns (data, label, used_indices, num_global_rows). Query files
+    (`path + ".query"`) trigger query-atomic assignment."""
+    import os
+
+    from ..io.parser import load_data_file
+    data, label = load_data_file(path, has_header=has_header)
+    n = data.shape[0]
+    qb = None
+    qpath = path + ".query"
+    if os.path.exists(qpath):
+        with open(qpath) as fh:
+            sizes = np.asarray([int(x) for x in fh.read().split()])
+        qb = np.concatenate([[0], np.cumsum(sizes)])
+        if qb[-1] != n:
+            log.fatal("Query file rows (%d) != data rows (%d)"
+                      % (qb[-1], n))
+    idx = partition_rows(n, rank, num_machines, query_boundaries=qb,
+                         seed=seed)
+    lab = label[idx] if label is not None else None
+    return data[idx], lab, idx, n
+
+
+def jax_process_allgather(payload: str, rank: int, num_machines: int
+                          ) -> List[str]:
+    """Allgather JSON strings across jax processes (the BinMapper exchange
+    of dataset_loader.cpp:780-817 on the jax distributed runtime)."""
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+
+    raw = np.frombuffer(payload.encode("utf-8"), np.uint8)
+    n = np.zeros((), np.int64) + len(raw)
+    lens = multihost_utils.process_allgather(jnp.asarray(n))
+    buf = np.zeros(int(lens.max()), np.uint8)
+    buf[:len(raw)] = raw
+    bufs = multihost_utils.process_allgather(jnp.asarray(buf))
+    return [bytes(np.asarray(bufs[i][:int(lens[i])])).decode("utf-8")
+            for i in range(num_machines)]
+
+
+def default_comm(num_machines: int):
+    """The BinMapper exchange channel: the jax multihost allgather when a
+    distributed runtime with multiple processes is up, else None (loopback
+    — find_bins_distributed plays every rank locally)."""
+    if num_machines <= 1:
+        return None
+    import jax
+    from jax._src import distributed as _dist
+    if getattr(_dist.global_state, "client", None) is not None \
+            and jax.process_count() > 1:
+        return jax_process_allgather
+    return None
+
+
+def feature_blocks(total_features: int, num_machines: int
+                   ) -> List[Tuple[int, int]]:
+    """Block-shard features as the reference does (step = ceil(F/M),
+    dataset_loader.cpp:743-752). Returns (start, len) per machine."""
+    step = max(1, (total_features + num_machines - 1) // num_machines)
+    blocks = []
+    start = 0
+    for i in range(num_machines):
+        ln = min(step, total_features - start) if i < num_machines - 1 \
+            else total_features - start
+        ln = max(ln, 0)
+        blocks.append((start, ln))
+        start += ln
+    return blocks
+
+
+def find_bins_distributed(sample: np.ndarray, rank: int, num_machines: int,
+                          max_bin: int = 255, min_data_in_bin: int = 3,
+                          total_sample_cnt: Optional[int] = None,
+                          categorical_features: Optional[Sequence[int]] = None,
+                          use_missing: bool = True,
+                          zero_as_missing: bool = False,
+                          comm: Optional[Callable] = None
+                          ) -> List[BinMapper]:
+    """Feature-sharded BinMapper construction + allgather.
+
+    `sample` is this rank's [sample_rows, F] value sample. Each rank runs
+    FindBin only for its feature block; `comm(payload, rank, m)` returns
+    every rank's serialized mappers. Without a comm (single process) the
+    loop below plays every rank locally — same code path, loopback
+    network."""
+    f = sample.shape[1]
+    total = total_sample_cnt if total_sample_cnt is not None \
+        else sample.shape[0]
+    cats = set(categorical_features or ())
+    blocks = feature_blocks(f, num_machines)
+
+    from ..binning import BIN_CATEGORICAL, BIN_NUMERICAL
+
+    def bins_for(block_rank: int) -> List[dict]:
+        start, ln = blocks[block_rank]
+        out = []
+        for j in range(start, start + ln):
+            col = np.asarray(sample[:, j], np.float64)
+            # FindBin's sampling contract: non-zero values + total count,
+            # zeros implied (bin.cpp:200-330)
+            nonzero = col[(col != 0.0) | np.isnan(col)]
+            m = BinMapper()
+            m.find_bin(nonzero, total, max_bin, min_data_in_bin, 0,
+                       BIN_CATEGORICAL if j in cats else BIN_NUMERICAL,
+                       use_missing, zero_as_missing)
+            out.append(m.to_dict())
+        return out
+
+    if comm is None and num_machines > 1:
+        # loopback: play all ranks in-process
+        payloads = [json.dumps(bins_for(r)) for r in range(num_machines)]
+    elif comm is None:
+        payloads = [json.dumps(bins_for(rank))]
+    else:
+        payloads = comm(json.dumps(bins_for(rank)), rank, num_machines)
+
+    mappers: List[BinMapper] = []
+    for payload in payloads:
+        for d in json.loads(payload):
+            mappers.append(BinMapper.from_dict(d))
+    if len(mappers) != f:
+        log.fatal("Distributed bin finding produced %d mappers for %d "
+                  "features" % (len(mappers), f))
+    return mappers
+
+
+def iter_parsed_chunks(path: str, has_header: bool = False,
+                       chunk_rows: int = 65536):
+    """Yield [<=chunk_rows, 1+F] float64 blocks of a delimited file without
+    ever materializing the whole matrix (reference: the two-round loaders'
+    per-block ExtractFeaturesFromFile, dataset_loader.cpp:630-665)."""
+    from ..io.parser import _parse_float, detect_format
+    fmt = detect_format(path, has_header)
+    delim = {"csv": ",", "tsv": None}.get(fmt)
+    if fmt == "libsvm":
+        log.fatal("two-round loading supports delimited files only")
+    with open(path) as fh:
+        if has_header:
+            fh.readline()
+        block: List[List[float]] = []
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            parts = line.split(delim) if delim else line.split()
+            block.append([_parse_float(p) for p in parts])
+            if len(block) >= chunk_rows:
+                yield np.asarray(block, np.float64)
+                block = []
+        if block:
+            yield np.asarray(block, np.float64)
+
+
+def two_round_load(path: str, max_bin: int = 255, min_data_in_bin: int = 3,
+                   bin_construct_sample_cnt: int = 200000,
+                   has_header: bool = False, seed: int = 1,
+                   chunk_rows: int = 65536, label_column: int = 0,
+                   rank: int = 0, num_machines: int = 1,
+                   comm: Optional[Callable] = None):
+    """Two-round file -> Dataset (use_two_round_loading,
+    dataset_loader.cpp:193-207): round one streams the file once to count
+    rows and reservoir-sample for bin finding; round two streams again,
+    binning each chunk straight into per-feature uint8 columns. Peak
+    memory is O(sample + chunk * F * 8B + rows * F * 1B) instead of
+    O(rows * F * 8B)."""
+    from ..dataset import Dataset as InnerDataset
+    from ..efb import find_groups
+
+    # round 1: reservoir sample + per-rank row ownership
+    rng = np.random.RandomState(seed)
+    reservoir: List[np.ndarray] = []
+    seen = 0
+    row_owner = np.random.RandomState(seed)  # same stream as partition_rows
+    local_rows = 0
+    for block in iter_parsed_chunks(path, has_header, chunk_rows):
+        mine = row_owner.randint(0, num_machines, size=len(block)) == rank \
+            if num_machines > 1 else np.ones(len(block), bool)
+        local_block = block[mine]
+        local_rows += len(local_block)
+        for row in local_block:
+            seen += 1
+            if len(reservoir) < bin_construct_sample_cnt:
+                reservoir.append(row)
+            else:
+                j = rng.randint(0, seen)
+                if j < bin_construct_sample_cnt:
+                    reservoir[j] = row
+    if not reservoir:
+        log.fatal("No rows for rank %d in %s" % (rank, path))
+    sample_full = np.asarray(reservoir)
+    sample = np.delete(sample_full, label_column, axis=1)
+    f = sample.shape[1]
+    # in a REAL multi-process run the mapper exchange must ride the
+    # distributed runtime — each rank's reservoir covers only its own
+    # rows, so without the allgather ranks would derive divergent bin
+    # boundaries and merge incompatible histograms
+    if comm is None:
+        comm = default_comm(num_machines)
+    mappers = find_bins_distributed(
+        sample, rank, num_machines, max_bin=max_bin,
+        min_data_in_bin=min_data_in_bin, total_sample_cnt=len(sample),
+        comm=comm)
+
+    # round 2: stream chunks into per-feature bin columns
+    used = [j for j, m in enumerate(mappers) if not m.is_trivial]
+    cols = [np.zeros(local_rows, np.uint8) for _ in used]
+    labels = np.zeros(local_rows, np.float32)
+    row_owner = np.random.RandomState(seed)
+    lo = 0
+    for block in iter_parsed_chunks(path, has_header, chunk_rows):
+        mine = row_owner.randint(0, num_machines, size=len(block)) == rank \
+            if num_machines > 1 else np.ones(len(block), bool)
+        block = block[mine]
+        if not len(block):
+            continue
+        hi = lo + len(block)
+        labels[lo:hi] = block[:, label_column]
+        feats = np.delete(block, label_column, axis=1)
+        for out_j, j in enumerate(used):
+            cols[out_j][lo:hi] = mappers[j].values_to_bins(
+                feats[:, j]).astype(np.uint8)
+        lo = hi
+
+    ds = InnerDataset()
+    ds.num_total_features = f
+    ds.max_bin = max_bin
+    ds.feature_names = [f"Column_{i}" for i in range(f)]
+    ds.mappers = mappers
+    ds.used_features = used
+    num_bins = np.asarray([mappers[j].num_bin for j in used], np.int32)
+    default_bins = np.asarray([mappers[j].default_bin for j in used],
+                              np.int32)
+    ds.groups = find_groups(cols, default_bins, num_bins, seed=seed)
+    ds.binned = (ds.groups.bundle_rows(cols, default_bins) if cols
+                 else np.zeros((local_rows, 0), np.uint8))
+    from ..dataset import Metadata
+    ds.metadata = Metadata(local_rows)
+    ds.metadata.set_label(labels)
+    return ds

@@ -1,0 +1,120 @@
+"""Multi-host execution wiring (reference: src/network/linkers_socket.cpp +
+linkers.h:86-258 — the TCP/MPI mesh construction).
+
+The TPU-native equivalent of the reference's machine-list socket mesh is
+`jax.distributed.initialize`: every process connects to a coordinator,
+after which `jax.devices()` is GLOBAL, a Mesh spans all hosts, and the
+grower's psum/pmax seams ride ICI within a slice and DCN across slices
+with XLA-chosen schedules (the Bruck/recursive-halving code is obsolete).
+
+Launch recipe (every host, reference examples/parallel_learning):
+
+    LGBM_TPU_COORDINATOR=host0:12400 LGBM_TPU_NUM_MACHINES=2 \
+    LGBM_TPU_RANK=<i> python -m lightgbm_tpu config=train.conf
+
+or with a reference-style machine list file (host port per line): the
+coordinator is the FIRST machine; this process's rank is its line index.
+"""
+from __future__ import annotations
+
+import os
+import socket
+from typing import Optional
+
+from .. import log
+
+
+def _rank_from_machine_list(path: str, port: int):
+    """Reference: Linkers::ParseMachineList + rank discovery by matching a
+    local interface address (linkers_socket.cpp)."""
+    machines = []
+    with open(path) as fh:
+        for line in fh:
+            parts = line.split()
+            if not parts:
+                continue
+            host = parts[0]
+            p = int(parts[1]) if len(parts) > 1 else port
+            machines.append((host, p))
+    if not machines:
+        log.fatal("Machine list %s is empty" % path)
+    local_names = {socket.gethostname(), "localhost", "127.0.0.1"}
+    try:
+        local_names.add(socket.gethostbyname(socket.gethostname()))
+    except OSError:
+        pass
+    rank = None
+    for i, (host, p) in enumerate(machines):
+        try:
+            addr = socket.gethostbyname(host)
+        except OSError:
+            addr = host
+        if host in local_names or addr in local_names:
+            # several list entries may share a host (multiple ranks on one
+            # box); the listen port disambiguates, as in the reference's
+            # local-port matching (linkers_socket.cpp)
+            if p == port or rank is None:
+                rank = i
+                if p == port:
+                    break
+    coordinator = f"{machines[0][0]}:{machines[0][1]}"
+    return coordinator, len(machines), rank
+
+
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None,
+                     machine_list_filename: str = "",
+                     local_listen_port: int = 12400) -> bool:
+    """Initialize the jax distributed runtime from explicit args, env vars
+    (LGBM_TPU_COORDINATOR / LGBM_TPU_NUM_MACHINES / LGBM_TPU_RANK), or a
+    reference-style machine list file. Returns True if a multi-process
+    runtime was started (idempotent; False for single-process runs)."""
+    import jax
+
+    coordinator_address = coordinator_address or \
+        os.environ.get("LGBM_TPU_COORDINATOR")
+    if num_processes is None and "LGBM_TPU_NUM_MACHINES" in os.environ:
+        num_processes = int(os.environ["LGBM_TPU_NUM_MACHINES"])
+    if process_id is None and "LGBM_TPU_RANK" in os.environ:
+        process_id = int(os.environ["LGBM_TPU_RANK"])
+
+    if coordinator_address is None and machine_list_filename:
+        coordinator_address, n, rank = _rank_from_machine_list(
+            machine_list_filename, local_listen_port)
+        num_processes = num_processes or n
+        if process_id is None:
+            process_id = rank
+    if coordinator_address is None:
+        return False
+    if num_processes is None or process_id is None:
+        log.fatal("Multi-host init needs num_machines and rank (env "
+                  "LGBM_TPU_NUM_MACHINES / LGBM_TPU_RANK or machine list)")
+    if num_processes <= 1:
+        return False
+    # NOTE: must not touch the backend (jax.devices / process_count)
+    # before distributed.initialize — probe the runtime state directly
+    from jax._src import distributed as _dist
+    if getattr(_dist.global_state, "client", None) is not None:
+        return True  # already initialized
+    log.info("Connecting %d machines, rank %d, coordinator %s",
+             num_processes, process_id, coordinator_address)
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    log.info("Distributed runtime up: %d processes, %d global devices",
+             jax.process_count(), len(jax.devices()))
+    return True
+
+
+def global_row_array(local_np, mesh, axis: str):
+    """Assemble a row-sharded GLOBAL jax.Array from this process's local
+    shard (the multihost analogue of handing the grower a full matrix —
+    each host contributes the rows its loader partition owns,
+    parallel/loader.py)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sharding = NamedSharding(mesh, P(axis) if local_np.ndim == 1
+                             else P(axis, *([None] * (local_np.ndim - 1))))
+    return jax.make_array_from_process_local_data(sharding, local_np)

@@ -1,0 +1,111 @@
+"""Distributed / two-round loading tests (reference:
+dataset_loader.cpp:159-217, 417-424, 737-817)."""
+import numpy as np
+import pytest
+
+from lightgbm_tpu.binning import find_bin_mappers
+from lightgbm_tpu.parallel.loader import (feature_blocks,
+                                          find_bins_distributed,
+                                          iter_parsed_chunks,
+                                          partition_rows, two_round_load)
+
+
+def test_partition_rows_covers_everything():
+    n, m = 1000, 4
+    parts = [partition_rows(n, r, m) for r in range(m)]
+    allrows = np.sort(np.concatenate(parts))
+    np.testing.assert_array_equal(allrows, np.arange(n))
+    # balanced-ish
+    sizes = [len(p) for p in parts]
+    assert min(sizes) > n / m * 0.7
+
+
+def test_partition_rows_query_atomic():
+    rng = np.random.RandomState(0)
+    sizes = rng.randint(1, 20, size=60)
+    qb = np.concatenate([[0], np.cumsum(sizes)])
+    n = int(qb[-1])
+    m = 3
+    owner = np.full(n, -1)
+    for r in range(m):
+        owner[partition_rows(n, r, m, query_boundaries=qb)] = r
+    assert (owner >= 0).all()
+    # all rows of a query belong to one machine
+    for q in range(len(qb) - 1):
+        rows = owner[qb[q]:qb[q + 1]]
+        assert len(set(rows.tolist())) == 1
+
+
+def test_feature_blocks_cover():
+    for f, m in [(10, 3), (5, 8), (28, 4), (1, 1)]:
+        blocks = feature_blocks(f, m)
+        assert len(blocks) == m
+        covered = []
+        for start, ln in blocks:
+            covered.extend(range(start, start + ln))
+        assert covered == list(range(f))
+
+
+def test_distributed_bin_finding_matches_serial():
+    """Feature-sharded FindBin + allgather == single-machine FindBin."""
+    rng = np.random.RandomState(2)
+    sample = rng.randn(500, 7)
+    sample[:, 3] = np.round(sample[:, 3])  # some repeated values
+    serial = find_bin_mappers(sample, max_bin=31, min_data_in_bin=3)
+    dist = find_bins_distributed(sample, rank=0, num_machines=3,
+                                 max_bin=31, min_data_in_bin=3)
+    assert len(dist) == len(serial)
+    for a, b in zip(dist, serial):
+        assert a.num_bin == b.num_bin
+        np.testing.assert_allclose(
+            np.asarray(a.bin_upper_bound, np.float64),
+            np.asarray(b.bin_upper_bound, np.float64))
+
+
+def test_two_round_load_matches_in_memory(tmp_path):
+    """Streamed two-round loading == the in-memory Dataset construction."""
+    from lightgbm_tpu.dataset import Dataset
+    rng = np.random.RandomState(1)
+    n, f = 3000, 5
+    X = rng.randn(n, f)
+    X[rng.rand(n, f) < 0.1] = 0.0
+    y = (X[:, 0] > 0).astype(float)
+    path = str(tmp_path / "t.tsv")
+    np.savetxt(path, np.column_stack([y, X]), delimiter="\t", fmt="%.8g")
+
+    streamed = two_round_load(path, max_bin=31, chunk_rows=256)
+    from lightgbm_tpu.io.parser import load_data_file
+    Xp, yp = load_data_file(path)
+    in_mem = Dataset.from_numpy(Xp, yp, max_bin=31)
+
+    assert streamed.num_data == in_mem.num_data == n
+    assert streamed.num_features == in_mem.num_features
+    np.testing.assert_array_equal(np.asarray(streamed.binned),
+                                  np.asarray(in_mem.binned))
+    np.testing.assert_allclose(streamed.metadata.label, yp)
+
+
+def test_two_round_load_rank_sharding(tmp_path):
+    rng = np.random.RandomState(3)
+    n, f = 2000, 4
+    X = rng.randn(n, f)
+    y = X[:, 0]
+    path = str(tmp_path / "t.tsv")
+    np.savetxt(path, np.column_stack([y, X]), delimiter="\t", fmt="%.8g")
+    total = 0
+    for r in range(3):
+        part = two_round_load(path, max_bin=15, chunk_rows=128, rank=r,
+                              num_machines=3)
+        total += part.num_data
+        assert part.num_data > 0
+    assert total == n
+
+
+def test_iter_parsed_chunks_roundtrip(tmp_path):
+    rng = np.random.RandomState(4)
+    arr = rng.randn(517, 3)
+    path = str(tmp_path / "c.tsv")
+    np.savetxt(path, arr, delimiter="\t", fmt="%.8g")
+    chunks = list(iter_parsed_chunks(path, chunk_rows=100))
+    assert sum(len(c) for c in chunks) == 517
+    np.testing.assert_allclose(np.vstack(chunks), np.loadtxt(path), rtol=1e-6)

@@ -1,0 +1,161 @@
+"""Accuracy + model-format parity against the REAL reference binary.
+
+Builds /root/reference out-of-tree (cached in /tmp/lgbm_ref_build, same
+recipe as scripts/measure_baseline.py), trains both frameworks on the same
+synthetic datasets with equal hyperparameters, and asserts:
+
+- metric parity (AUC / L2) within tolerance on binary + regression;
+- cross-loading: a reference-written model file predicts identically when
+  loaded by this framework;
+- cross-loading the other way: a model written here is read by the
+  reference CLI and its file predictions match ours.
+
+Skipped when the reference tree or a toolchain is unavailable.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REFERENCE = "/root/reference"
+
+
+@pytest.fixture(scope="module")
+def ref_exe():
+    if not os.path.isdir(REFERENCE):
+        pytest.skip("reference tree not present")
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    from measure_baseline import build_reference
+    try:
+        return build_reference()
+    except Exception as e:  # noqa: BLE001
+        pytest.skip(f"cannot build reference: {e}")
+
+
+def _run_ref(ref_exe, workdir, **conf):
+    args = [ref_exe] + [f"{k}={v}" for k, v in conf.items()]
+    res = subprocess.run(args, cwd=workdir, capture_output=True, text=True,
+                         timeout=600)
+    assert res.returncode == 0, res.stdout + res.stderr
+    return res.stdout
+
+
+def _auc(y, s):
+    order = np.argsort(s)
+    ranks = np.empty(len(s))
+    ranks[order] = np.arange(1, len(s) + 1)
+    pos = y > 0
+    return ((ranks[pos].sum() - pos.sum() * (pos.sum() + 1) / 2)
+            / (pos.sum() * (~pos).sum()))
+
+
+def _binary_data(tmp, n=20000, f=12, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f).astype(np.float32)
+    score = X[:, 0] * 1.2 - X[:, 1] + 0.8 * X[:, 2] * X[:, 3] \
+        + 0.5 * np.abs(X[:, 4])
+    y = (score + rng.logistic(size=n) > 0.3).astype(np.float32)
+    path = os.path.join(tmp, "bin.train")
+    np.savetxt(path, np.column_stack([y, X]), fmt="%.6g", delimiter="\t")
+    return X, y, path
+
+
+PARAMS = dict(num_leaves=31, max_bin=63, learning_rate=0.1,
+              min_data_in_leaf=20, min_sum_hessian_in_leaf=1e-3)
+
+
+def test_binary_auc_parity(ref_exe, tmp_path):
+    tmp = str(tmp_path)
+    X, y, data_path = _binary_data(tmp)
+    iters = 30
+
+    ref_model = os.path.join(tmp, "ref_model.txt")
+    _run_ref(ref_exe, tmp, task="train", objective="binary", data=data_path,
+             num_trees=iters, output_model=ref_model, verbosity=-1,
+             **PARAMS)
+    ref_pred_file = os.path.join(tmp, "ref_preds.txt")
+    _run_ref(ref_exe, tmp, task="predict", data=data_path,
+             input_model=ref_model, output_result=ref_pred_file,
+             verbosity=-1)
+    ref_preds = np.loadtxt(ref_pred_file)
+
+    # both frameworks must see the exact same values: what the reference
+    # CLI trained/predicted on is the PARSED text file, not the raw array
+    from lightgbm_tpu.io.parser import load_data_file
+    Xp, yp = load_data_file(data_path)
+    ours = lgb.train(dict(objective="binary", verbose=-1, **PARAMS),
+                     lgb.Dataset(Xp, yp, params=dict(PARAMS)),
+                     num_boost_round=iters, verbose_eval=False)
+    our_preds = ours.predict(Xp)
+
+    auc_ref = _auc(y, ref_preds)
+    auc_ours = _auc(y, our_preds)
+    # same-data training AUC within 0.5% of the reference binary
+    assert abs(auc_ref - auc_ours) < 5e-3, (auc_ref, auc_ours)
+
+    # cross-load: reference-written model through OUR loader
+    loaded = lgb.Booster(model_file=ref_model)
+    cross = loaded.predict(Xp)
+    np.testing.assert_allclose(cross, ref_preds, rtol=1e-4, atol=1e-5)
+
+    # cross-load the other way: OUR model through the reference CLI
+    our_model = os.path.join(tmp, "our_model.txt")
+    ours.save_model(our_model)
+    out_pred_file = os.path.join(tmp, "ours_via_ref.txt")
+    _run_ref(ref_exe, tmp, task="predict", data=data_path,
+             input_model=our_model, output_result=out_pred_file,
+             verbosity=-1)
+    via_ref = np.loadtxt(out_pred_file)
+    np.testing.assert_allclose(via_ref, our_preds, rtol=1e-4, atol=1e-5)
+
+
+def test_regression_l2_parity(ref_exe, tmp_path):
+    tmp = str(tmp_path)
+    rng = np.random.RandomState(1)
+    n, f = 20000, 10
+    X = rng.randn(n, f).astype(np.float32)
+    y = (X[:, 0] * 2 + X[:, 1] ** 2 - X[:, 2] * X[:, 3]
+         + 0.2 * rng.randn(n)).astype(np.float32)
+    data_path = os.path.join(tmp, "reg.train")
+    np.savetxt(data_path, np.column_stack([y, X]), fmt="%.6g",
+               delimiter="\t")
+    iters = 30
+
+    ref_model = os.path.join(tmp, "ref_model.txt")
+    _run_ref(ref_exe, tmp, task="train", objective="regression",
+             data=data_path, num_trees=iters, output_model=ref_model,
+             verbosity=-1, **PARAMS)
+    ref_pred_file = os.path.join(tmp, "ref_preds.txt")
+    _run_ref(ref_exe, tmp, task="predict", data=data_path,
+             input_model=ref_model, output_result=ref_pred_file,
+             verbosity=-1)
+    ref_preds = np.loadtxt(ref_pred_file)
+
+    from lightgbm_tpu.io.parser import load_data_file
+    Xp, yp = load_data_file(data_path)
+    ours = lgb.train(dict(objective="regression", verbose=-1, **PARAMS),
+                     lgb.Dataset(Xp, yp, params=dict(PARAMS)),
+                     num_boost_round=iters, verbose_eval=False)
+    our_preds = ours.predict(Xp)
+
+    mse_ref = float(np.mean((ref_preds - y) ** 2))
+    mse_ours = float(np.mean((our_preds - y) ** 2))
+    var = float(np.var(y))
+    # train L2 within 2% of label variance of each other
+    assert abs(mse_ref - mse_ours) < 0.02 * var, (mse_ref, mse_ours)
+
+    # round-trip our regression model through the reference binary
+    our_model = os.path.join(tmp, "our_model.txt")
+    ours.save_model(our_model)
+    out_pred_file = os.path.join(tmp, "ours_via_ref.txt")
+    _run_ref(ref_exe, tmp, task="predict", data=data_path,
+             input_model=our_model, output_result=out_pred_file,
+             verbosity=-1)
+    via_ref = np.loadtxt(out_pred_file)
+    np.testing.assert_allclose(via_ref, our_preds, rtol=1e-4, atol=1e-4)
