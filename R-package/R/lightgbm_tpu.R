@@ -1,0 +1,118 @@
+# R interface to lightgbm_tpu (reference surface: R-package/R/ in
+# LightGBM — lgb.Dataset / lgb.train / predict / lgb.importance).
+#
+# Transport: the framework's CLI (`python -m lightgbm_tpu`) and the
+# LightGBM-compatible text model format. The reference binds in-process
+# through lightgbm_R.cpp over the C API; the equivalent here is
+# native/lib_lightgbm_tpu.so (the LGBM_* C ABI), which .Call glue can
+# target — the CLI transport is used by default because it has no compiled
+# dependency on the R toolchain.
+
+.lgb_python <- function() {
+  py <- Sys.getenv("LGBM_TPU_PYTHON", "python3")
+  py
+}
+
+.lgb_repo <- function() {
+  repo <- Sys.getenv("LGBM_TPU_HOME", "")
+  if (nzchar(repo)) return(repo)
+  # installed alongside the package
+  system.file(package = "lightgbmtpu")
+}
+
+.lgb_cli <- function(args) {
+  env <- paste0("PYTHONPATH=", shQuote(.lgb_repo()))
+  rc <- system2(.lgb_python(), c("-m", "lightgbm_tpu", args),
+                env = env, stdout = TRUE, stderr = TRUE)
+  status <- attr(rc, "status")
+  if (!is.null(status) && status != 0) {
+    stop("lightgbm_tpu CLI failed:\n", paste(rc, collapse = "\n"))
+  }
+  invisible(rc)
+}
+
+#' Create a dataset descriptor (data written as TSV with the label in
+#' column 0, the CLI's native layout).
+lgb.Dataset <- function(data, label = NULL, weight = NULL, group = NULL) {
+  path <- tempfile(fileext = ".tsv")
+  mat <- as.matrix(data)
+  if (is.null(label)) label <- rep(0, nrow(mat))
+  utils::write.table(cbind(label, mat), path, sep = "\t",
+                     row.names = FALSE, col.names = FALSE)
+  if (!is.null(weight)) {
+    writeLines(as.character(weight), paste0(path, ".weight"))
+  }
+  if (!is.null(group)) {
+    writeLines(as.character(group), paste0(path, ".query"))
+  }
+  structure(list(path = path, nrow = nrow(mat), ncol = ncol(mat)),
+            class = "lgb.Dataset")
+}
+
+#' Train a model (reference: lgb.train). `params` is a named list using
+#' LightGBM parameter names; returns an lgb.Booster.
+lgb.train <- function(params = list(), data, nrounds = 100L,
+                      valids = list(), verbose = -1L) {
+  stopifnot(inherits(data, "lgb.Dataset"))
+  model_path <- tempfile(fileext = ".txt")
+  args <- c("task=train",
+            paste0("data=", data$path),
+            paste0("num_trees=", as.integer(nrounds)),
+            paste0("output_model=", model_path),
+            paste0("verbose=", as.integer(verbose)))
+  for (name in names(params)) {
+    args <- c(args, paste0(name, "=", params[[name]]))
+  }
+  if (length(valids)) {
+    vpaths <- vapply(valids, function(v) v$path, character(1))
+    args <- c(args, paste0("valid=", paste(vpaths, collapse = ",")))
+  }
+  .lgb_cli(args)
+  booster <- structure(list(model_path = model_path,
+                            model_str = readLines(model_path)),
+                       class = "lgb.Booster")
+  booster
+}
+
+#' Predict with a trained model (reference: predict.lgb.Booster).
+predict.lgb.Booster <- function(object, data, rawscore = FALSE,
+                                predleaf = FALSE, ...) {
+  ds <- if (inherits(data, "lgb.Dataset")) data else lgb.Dataset(data)
+  out_path <- tempfile(fileext = ".txt")
+  args <- c("task=predict",
+            paste0("data=", ds$path),
+            paste0("input_model=", object$model_path),
+            paste0("output_result=", out_path),
+            "verbose=-1")
+  if (rawscore) args <- c(args, "predict_raw_score=true")
+  if (predleaf) args <- c(args, "predict_leaf_index=true")
+  .lgb_cli(args)
+  res <- utils::read.table(out_path, sep = "\t")
+  if (ncol(res) == 1) res[[1]] else as.matrix(res)
+}
+
+#' Feature importance parsed from the model text (reference:
+#' lgb.importance over the dumped model).
+lgb.importance <- function(booster) {
+  stopifnot(inherits(booster, "lgb.Booster"))
+  lines <- booster$model_str
+  feat_line <- grep("^feature_names=", lines, value = TRUE)
+  feats <- strsplit(sub("^feature_names=", "", feat_line), " ")[[1]]
+  counts <- integer(length(feats))
+  for (sf in grep("^split_feature=", lines, value = TRUE)) {
+    idx <- as.integer(strsplit(sub("^split_feature=", "", sf), " ")[[1]])
+    for (i in idx) counts[i + 1] <- counts[i + 1] + 1L
+  }
+  data.frame(Feature = feats, Frequency = counts)[order(-counts), ]
+}
+
+#' Save / load the LightGBM-compatible text model.
+lgb.save <- function(booster, filename) {
+  writeLines(booster$model_str, filename)
+  invisible(booster)
+}
+
+lgb.load <- function(filename) {
+  structure(list(model_path = filename, model_str = readLines(filename)),
+            class = "lgb.Booster")
+}

@@ -71,6 +71,28 @@ class Dataset:
         self._predictor = None
         self._constructed_max_bin: Optional[int] = None
 
+    @classmethod
+    def _from_inner(cls, inner) -> "Dataset":
+        """Wrap an already-constructed _InnerDataset (binary fast path /
+        two-round loader)."""
+        ds = cls.__new__(cls)
+        ds.data = None
+        ds.label = inner.metadata.label
+        ds.max_bin = inner.max_bin
+        ds.reference = None
+        ds.weight = None
+        ds.group = None
+        ds.init_score = None
+        ds.params = {}
+        ds.feature_name = "auto"
+        ds.categorical_feature = "auto"
+        ds.free_raw_data = True
+        ds._inner = inner
+        ds.used_indices = None
+        ds._predictor = None
+        ds._constructed_max_bin = inner.max_bin
+        return ds
+
     def _update_params(self, params: Dict[str, Any]) -> "Dataset":
         """Fold training-time params into the not-yet-constructed dataset
         (reference: basic.py Dataset._update_params — binning params like

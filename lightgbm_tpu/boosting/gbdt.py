@@ -157,6 +157,16 @@ class GBDT:
             else "serial"
         ndev = len(jax.devices()) if self._tree_learner_kind != "serial" else 1
         self._num_shards = ndev
+        # multi-host: jax.devices() is GLOBAL; this process holds a row
+        # SHARD of the training data (parallel/loader.py partitioning) and
+        # pads it to local-device granularity — the data-parallel grower
+        # assembles the global row axis (multihost.global_row_array)
+        nproc = jax.process_count()
+        self._num_processes = nproc
+        if nproc > 1 and self._tree_learner_kind not in ("data", "voting"):
+            log.fatal("Multi-host training requires tree_learner=data or "
+                      "voting (got %s)" % self._tree_learner_kind)
+        local_dev = max(1, ndev // nproc)
 
         chunk = min(self.config.tree.tpu_hist_chunk, 1 << 20)
         # bound the histogram pass working set (one-hot is [chunk, G, B]):
@@ -165,9 +175,15 @@ class GBDT:
         ws_cap = max(256, 1 << int(np.floor(np.log2(max(1, (1 << 26) // gb)))))
         chunk = min(chunk, ws_cap)
         self._chunk = int(min(chunk, max(256, 1 << int(np.ceil(np.log2(max(n, 1)))))))
-        row_multiple = self._chunk * (ndev if self._tree_learner_kind in
-                                      ("data", "voting") else 1)
+        row_multiple = self._chunk * (local_dev if nproc > 1 else ndev) \
+            if self._tree_learner_kind in ("data", "voting") else self._chunk
         n_pad = ((n + row_multiple - 1) // row_multiple) * row_multiple
+        if nproc > 1:
+            # every process must contribute an equal-sized row block to
+            # the global array: pad all shards to the largest
+            from jax.experimental import multihost_utils
+            n_pad = int(multihost_utils.process_allgather(
+                jnp.asarray(np.int64(n_pad))).max())
         self._n = n
         self._n_pad = n_pad
 
@@ -183,6 +199,20 @@ class GBDT:
             if train_data.metadata.label is None:
                 log.fatal("Training data must have a label")
             objective.init(train_data.metadata, n)
+            if nproc > 1:
+                # label statistics (bias, class counts) were computed on
+                # this shard only — sum them across processes (the
+                # reference's distributed boost-from-average Allreduce,
+                # gbdt.cpp:298-335)
+                from jax.experimental import multihost_utils
+
+                def _allreduce_sum(arr):
+                    g = multihost_utils.process_allgather(
+                        jnp.asarray(np.asarray(arr, np.float64)
+                                    .astype(np.float32)))
+                    return np.asarray(g, np.float64).sum(axis=0)
+
+                objective.sync_distributed(_allreduce_sum)
             objective.pad_to(n_pad)
 
         self._base_weight = jnp.asarray(
@@ -428,8 +458,14 @@ class GBDT:
                     # gbdt.cpp:521)
                     with tracing.phase("boosting/update_score"):
                         leaf_vals = jnp.asarray(tree.leaf_value, jnp.float32)
+                        lid = state.leaf_id
+                        if self._num_processes > 1:
+                            # scores are per-process row shards; pull this
+                            # process's block of the global leaf ids
+                            from ..parallel.multihost import local_rows
+                            lid = jnp.asarray(local_rows(state.leaf_id))
                         self._score = self._score.at[cls].add(
-                            leaf_vals[jnp.clip(state.leaf_id, 0,
+                            leaf_vals[jnp.clip(lid, 0,
                                                tree.num_leaves - 1)])
 
             if tree.num_leaves > 1:

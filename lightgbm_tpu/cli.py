@@ -57,11 +57,76 @@ def parse_cli_params(argv: List[str]) -> Dict[str, str]:
     return params
 
 
+def _check_binary_dataset(path: str):
+    """Binary-dataset fast path (reference: CheckCanLoadFromBin,
+    dataset_loader.cpp:240-263 — `file` or `file.bin` with the magic
+    token loads without re-parsing/re-binning)."""
+    from .dataset import _BINARY_MAGIC
+    for cand in (path, path + ".bin"):
+        if not os.path.exists(cand):
+            continue
+        with open(cand, "rb") as fh:
+            if fh.read(len(_BINARY_MAGIC)) == _BINARY_MAGIC:
+                return cand
+    return None
+
+
 def _build_dataset(path: str, params: Dict, cfg: Config,
                    reference: Dataset = None) -> Dataset:
     has_header = cfg.io.has_header
-    data, label = load_data_file(path, has_header=has_header)
-    ds = Dataset(data, label=label, params=dict(params), reference=reference)
+    # multi-process training: this process loads its row partition with
+    # globally-consistent distributed bin finding (reference:
+    # dataset_loader.cpp:159-217 + 737-817); pre-partitioned files keep
+    # all their rows but still sync mappers
+    import jax
+    nproc = jax.process_count()
+    if nproc > 1 and reference is None:
+        from .parallel.loader import jax_process_allgather, two_round_load
+        rank = jax.process_index()
+        log.info("Rank %d/%d loading %s (pre_partition=%s)", rank, nproc,
+                 path, cfg.io.is_pre_partition)
+        inner = two_round_load(
+            path, max_bin=cfg.io.max_bin,
+            min_data_in_bin=cfg.io.min_data_in_bin,
+            bin_construct_sample_cnt=cfg.io.bin_construct_sample_cnt,
+            has_header=has_header, seed=cfg.io.data_random_seed,
+            rank=rank, num_machines=nproc, comm=jax_process_allgather,
+            shard_rows=not cfg.io.is_pre_partition,
+            use_missing=cfg.io.use_missing,
+            zero_as_missing=cfg.io.zero_as_missing,
+            # EFB grouping is derived from local row samples and could
+            # diverge across ranks, which would misalign the stored
+            # histogram layout — keep features unbundled under multi-host
+            enable_bundle=False,
+            max_conflict_rate=cfg.io.max_conflict_rate,
+            sparse_threshold=cfg.io.sparse_threshold)
+        return Dataset._from_inner(inner)
+    bin_path = _check_binary_dataset(path) \
+        if cfg.io.enable_load_from_binary_file else None
+    if bin_path is not None and reference is None:
+        from .dataset import Dataset as InnerDataset
+        log.info("Loading binary dataset from %s (binning params come "
+                 "from the cache; enable_load_from_binary_file=false "
+                 "re-bins)", bin_path)
+        ds = Dataset._from_inner(InnerDataset.load_binary(bin_path))
+    elif cfg.io.use_two_round_loading and reference is None:
+        from .parallel.loader import two_round_load
+        log.info("Two-round loading %s", path)
+        inner = two_round_load(
+            path, max_bin=cfg.io.max_bin,
+            min_data_in_bin=cfg.io.min_data_in_bin,
+            bin_construct_sample_cnt=cfg.io.bin_construct_sample_cnt,
+            has_header=has_header, seed=cfg.io.data_random_seed,
+            use_missing=cfg.io.use_missing,
+            zero_as_missing=cfg.io.zero_as_missing,
+            enable_bundle=cfg.io.enable_bundle,
+            max_conflict_rate=cfg.io.max_conflict_rate,
+            sparse_threshold=cfg.io.sparse_threshold)
+        ds = Dataset._from_inner(inner)
+    else:
+        data, label = load_data_file(path, has_header=has_header)
+        ds = Dataset(data, label=label, params=dict(params),
+                     reference=reference)
     weights = load_weight_file(path)
     if weights is not None:
         ds.set_weight(weights)
@@ -72,6 +137,9 @@ def _build_dataset(path: str, params: Dict, cfg: Config,
     if os.path.exists(init_path):
         with open(init_path) as fh:
             ds.set_init_score(np.asarray([float(x) for x in fh.read().split()]))
+    if cfg.io.is_save_binary_file and bin_path is None:
+        ds.construct()
+        ds._inner.save_binary(path + ".bin")
     return ds
 
 
@@ -166,10 +234,16 @@ def main(argv: List[str] = None) -> int:
     # application.cpp:190-224 — here jax.distributed over the machine list)
     if cfg.network.num_machines > 1:
         from .parallel.multihost import init_distributed
-        init_distributed(
+        up = init_distributed(
             num_processes=cfg.network.num_machines,
             machine_list_filename=cfg.network.machine_list_filename,
             local_listen_port=cfg.network.local_listen_port)
+        if not up:
+            log.fatal(
+                "num_machines=%d but no distributed runtime could be "
+                "initialized: set LGBM_TPU_COORDINATOR / "
+                "LGBM_TPU_NUM_MACHINES / LGBM_TPU_RANK or provide "
+                "machine_list_file" % cfg.network.num_machines)
 
     task = cfg.task
     if task == "train":

@@ -83,6 +83,15 @@ class ObjectiveFunction:
     def to_string(self) -> str:
         return self.name
 
+    def sync_distributed(self, allreduce_sum) -> None:
+        """Fix label statistics computed on a row SHARD under multi-host
+        training: `allreduce_sum(np_array) -> np_array` sums across
+        processes (reference: the distributed boost-from-average
+        Allreduce, gbdt.cpp:298-335, and the cross-machine label-count
+        sync in binary_objective). Objectives whose statistics are purely
+        per-row or per-query (held whole on one shard) need nothing."""
+        return None
+
 
 class RegressionL2(ObjectiveFunction):
     """reference: regression_objective.hpp:13-79 (grad = score - label)."""
@@ -104,9 +113,14 @@ class RegressionL2(ObjectiveFunction):
         lab = np.asarray(metadata.label)
         if metadata.weights is not None:
             w = np.asarray(metadata.weights)
-            self._bias = float(np.sum(lab * w) / np.sum(w))
+            self._sums = np.array([np.sum(lab * w), np.sum(w)])
         else:
-            self._bias = float(lab.mean())
+            self._sums = np.array([lab.sum(), float(len(lab))])
+        self._bias = float(self._sums[0] / self._sums[1])
+
+    def sync_distributed(self, allreduce_sum):
+        self._sums = allreduce_sum(self._sums)
+        self._bias = float(self._sums[0] / self._sums[1])
 
     def bias(self):
         return self._bias
@@ -221,6 +235,11 @@ class BinaryLogloss(ObjectiveFunction):
         if cnt_pos == 0 or cnt_neg == 0:
             log.warning("Only one class present in label")
         log.info("Number of positive: %d, number of negative: %d", cnt_pos, cnt_neg)
+        self._cnt_pos, self._cnt_neg = cnt_pos, cnt_neg
+        self._set_label_weights()
+
+    def _set_label_weights(self):
+        cnt_pos, cnt_neg = self._cnt_pos, self._cnt_neg
         w_neg, w_pos = 1.0, 1.0
         if self.is_unbalance and cnt_pos > 0 and cnt_neg > 0:
             if cnt_pos > cnt_neg:
@@ -229,7 +248,12 @@ class BinaryLogloss(ObjectiveFunction):
                 w_pos = cnt_neg / cnt_pos
         w_pos *= self.scale_pos_weight
         self.label_weights = (w_neg, w_pos)
-        self._cnt_pos, self._cnt_neg = cnt_pos, cnt_neg
+
+    def sync_distributed(self, allreduce_sum):
+        s = allreduce_sum(np.array([self._cnt_pos, self._cnt_neg],
+                                   np.float64))
+        self._cnt_pos, self._cnt_neg = int(s[0]), int(s[1])
+        self._set_label_weights()
 
     def get_gradients(self, score):
         is_pos = self.label > 0
@@ -344,11 +368,19 @@ class CrossEntropy(ObjectiveFunction):
             log.fatal("[xentropy]: labels must be in [0, 1]")
         if metadata.weights is not None:
             w = np.asarray(metadata.weights)
-            pavg = float(np.sum(lab * w) / np.sum(w))
+            self._sums = np.array([np.sum(lab * w), np.sum(w)])
         else:
-            pavg = float(lab.mean())
+            self._sums = np.array([lab.sum(), float(len(lab))])
+        self._set_bias()
+
+    def _set_bias(self):
+        pavg = float(self._sums[0] / self._sums[1])
         pavg = min(max(pavg, 1e-15), 1 - 1e-15)
         self._bias = float(np.log(pavg / (1 - pavg)))
+
+    def sync_distributed(self, allreduce_sum):
+        self._sums = allreduce_sum(self._sums)
+        self._set_bias()
 
     def get_gradients(self, score):
         p = 1.0 / (1.0 + jnp.exp(-score))

@@ -59,11 +59,36 @@ class DataParallelGrower:
         self.axis = axis
         self.nshards = mesh.shape[axis]
         self.cfg = cfg._replace(data_axis=axis)
+        self._global_binned = None
+        self._global_binned_id = None
 
     def __call__(self, binned, grad, hess, row_weight, feature_mask,
                  fmeta: Dict):
         cfg = self.cfg
         ax = self.axis
+        # multi-host: inputs arrive as THIS PROCESS's row shard — assemble
+        # the global row axis (each host contributes its loader partition,
+        # parallel/multihost.py); binned is assembled once and cached
+        if jax.process_count() > 1:
+            from .multihost import global_row_array
+
+            def needs_assembly(a):
+                return not (isinstance(a, jax.Array)
+                            and not a.is_fully_addressable)
+
+            if needs_assembly(binned):
+                if self._global_binned_id != id(binned):
+                    self._global_binned = global_row_array(
+                        np.asarray(binned), self.mesh, ax)
+                    self._global_binned_id = id(binned)
+                binned = self._global_binned
+            if needs_assembly(grad):
+                grad = global_row_array(np.asarray(grad), self.mesh, ax)
+            if needs_assembly(hess):
+                hess = global_row_array(np.asarray(hess), self.mesh, ax)
+            if needs_assembly(row_weight):
+                row_weight = global_row_array(np.asarray(row_weight),
+                                              self.mesh, ax)
         # out_specs: leaf_id stays sharded by rows; everything else is
         # replicated (identical on all shards by construction)
         state_spec = self._state_specs()

@@ -211,7 +211,12 @@ def two_round_load(path: str, max_bin: int = 255, min_data_in_bin: int = 3,
                    has_header: bool = False, seed: int = 1,
                    chunk_rows: int = 65536, label_column: int = 0,
                    rank: int = 0, num_machines: int = 1,
-                   comm: Optional[Callable] = None):
+                   comm: Optional[Callable] = None, shard_rows: bool = True,
+                   categorical_features: Optional[Sequence[int]] = None,
+                   use_missing: bool = True, zero_as_missing: bool = False,
+                   enable_bundle: bool = True,
+                   max_conflict_rate: float = 0.0,
+                   sparse_threshold: float = 0.8):
     """Two-round file -> Dataset (use_two_round_loading,
     dataset_loader.cpp:193-207): round one streams the file once to count
     rows and reservoir-sample for bin finding; round two streams again,
@@ -222,6 +227,7 @@ def two_round_load(path: str, max_bin: int = 255, min_data_in_bin: int = 3,
     from ..efb import find_groups
 
     # round 1: reservoir sample + per-rank row ownership
+    shard = shard_rows and num_machines > 1
     rng = np.random.RandomState(seed)
     reservoir: List[np.ndarray] = []
     seen = 0
@@ -229,7 +235,7 @@ def two_round_load(path: str, max_bin: int = 255, min_data_in_bin: int = 3,
     local_rows = 0
     for block in iter_parsed_chunks(path, has_header, chunk_rows):
         mine = row_owner.randint(0, num_machines, size=len(block)) == rank \
-            if num_machines > 1 else np.ones(len(block), bool)
+            if shard else np.ones(len(block), bool)
         local_block = block[mine]
         local_rows += len(local_block)
         for row in local_block:
@@ -254,6 +260,8 @@ def two_round_load(path: str, max_bin: int = 255, min_data_in_bin: int = 3,
     mappers = find_bins_distributed(
         sample, rank, num_machines, max_bin=max_bin,
         min_data_in_bin=min_data_in_bin, total_sample_cnt=len(sample),
+        categorical_features=categorical_features,
+        use_missing=use_missing, zero_as_missing=zero_as_missing,
         comm=comm)
 
     # round 2: stream chunks into per-feature bin columns
@@ -264,7 +272,7 @@ def two_round_load(path: str, max_bin: int = 255, min_data_in_bin: int = 3,
     lo = 0
     for block in iter_parsed_chunks(path, has_header, chunk_rows):
         mine = row_owner.randint(0, num_machines, size=len(block)) == rank \
-            if num_machines > 1 else np.ones(len(block), bool)
+            if shard else np.ones(len(block), bool)
         block = block[mine]
         if not len(block):
             continue
@@ -285,7 +293,10 @@ def two_round_load(path: str, max_bin: int = 255, min_data_in_bin: int = 3,
     num_bins = np.asarray([mappers[j].num_bin for j in used], np.int32)
     default_bins = np.asarray([mappers[j].default_bin for j in used],
                               np.int32)
-    ds.groups = find_groups(cols, default_bins, num_bins, seed=seed)
+    ds.groups = find_groups(cols, default_bins, num_bins,
+                            enable_bundle=enable_bundle,
+                            max_conflict_rate=max_conflict_rate,
+                            sparse_threshold=sparse_threshold, seed=seed)
     ds.binned = (ds.groups.bundle_rows(cols, default_bins) if cols
                  else np.zeros((local_rows, 0), np.uint8))
     from ..dataset import Metadata

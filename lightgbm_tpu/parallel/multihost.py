@@ -107,6 +107,15 @@ def init_distributed(coordinator_address: Optional[str] = None,
     return True
 
 
+def local_rows(global_array):
+    """This process's row block of a row-sharded global array, in row
+    order (inverse of global_row_array)."""
+    import numpy as np
+    shards = sorted(global_array.addressable_shards,
+                    key=lambda s: s.index[0].start or 0)
+    return np.concatenate([np.asarray(s.data) for s in shards], axis=0)
+
+
 def global_row_array(local_np, mesh, axis: str):
     """Assemble a row-sharded GLOBAL jax.Array from this process's local
     shard (the multihost analogue of handing the grower a full matrix —

@@ -117,3 +117,33 @@ def test_cli_snapshot_freq(data_files):
     import lightgbm_tpu as lgb
     b = lgb.Booster(model_file=f"{model_path}.snapshot_iter_2")
     assert b.num_trees() == 2
+
+
+def test_cli_binary_fast_path_and_two_round(data_files):
+    """save_binary writes a .bin cache; retraining auto-loads it
+    (reference: CheckCanLoadFromBin, dataset_loader.cpp:240-263), and
+    two_round=true streams the file instead of materializing it."""
+    from lightgbm_tpu.cli import main
+    tmp_path, train_path, _ = data_files
+    m1 = tmp_path / "m1.txt"
+    assert main(["task=train", "objective=binary", f"data={train_path}",
+                 "num_trees=5", "num_leaves=7", "save_binary=true",
+                 f"output_model={m1}", "verbose=-1"]) == 0
+    assert os.path.exists(train_path + ".bin")
+    # second run loads the binary cache and must produce the same model
+    m2 = tmp_path / "m2.txt"
+    assert main(["task=train", "objective=binary", f"data={train_path}",
+                 "num_trees=5", "num_leaves=7",
+                 f"output_model={m2}", "verbose=-1"]) == 0
+    t1 = [ln for ln in open(m1) if not ln.startswith("init_score")]
+    t2 = [ln for ln in open(m2) if not ln.startswith("init_score")]
+    assert t1 == t2
+    os.remove(train_path + ".bin")
+
+    # two-round loading trains equivalently
+    m3 = tmp_path / "m3.txt"
+    assert main(["task=train", "objective=binary", f"data={train_path}",
+                 "num_trees=5", "num_leaves=7", "two_round=true",
+                 f"output_model={m3}", "verbose=-1"]) == 0
+    t3 = [ln for ln in open(m3) if not ln.startswith("init_score")]
+    assert t1 == t3
