@@ -1,0 +1,76 @@
+"""Generate the example datasets (the reference ships ~7MB of data files;
+this repo generates statistically-similar synthetic stand-ins so the
+train.conf files run unmodified).
+
+Usage: python examples/gen_data.py
+"""
+import os
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def write(path, y, X, fmt="%.6g"):
+    np.savetxt(path, np.column_stack([y, X]), delimiter="\t", fmt=fmt)
+    print(path, X.shape)
+
+
+def binary(n=7000, f=28, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    s = X[:, 0] * 1.2 - X[:, 1] + 0.8 * X[:, 2] * X[:, 3] + 0.5 * np.abs(X[:, 4])
+    y = (s + rng.logistic(size=n) > 0.3).astype(int)
+    d = os.path.join(HERE, "binary_classification")
+    write(os.path.join(d, "binary.train"), y[:5000], X[:5000])
+    write(os.path.join(d, "binary.test"), y[5000:], X[5000:])
+
+
+def regression(n=7000, f=20, seed=1):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = X[:, 0] * 2 + X[:, 1] ** 2 - X[:, 2] * X[:, 3] + 0.3 * rng.randn(n)
+    d = os.path.join(HERE, "regression")
+    write(os.path.join(d, "regression.train"), y[:5000], X[:5000])
+    write(os.path.join(d, "regression.test"), y[5000:], X[5000:])
+
+
+def lambdarank(n_queries=250, seed=2):
+    rng = np.random.RandomState(seed)
+    rows, sizes = [], []
+    for _ in range(n_queries):
+        c = rng.randint(5, 40)
+        sizes.append(c)
+        Xq = rng.randn(c, 16)
+        rel = np.clip(Xq[:, 0] * 1.5 + 0.4 * rng.randn(c), 0, None)
+        yq = np.minimum(rel.astype(int), 4)
+        rows.append(np.column_stack([yq, Xq]))
+    arr = np.vstack(rows)
+    split_q = int(n_queries * 0.8)
+    split_r = int(np.cumsum(sizes)[split_q - 1])
+    d = os.path.join(HERE, "lambdarank")
+    np.savetxt(os.path.join(d, "rank.train"), arr[:split_r], delimiter="\t", fmt="%.6g")
+    np.savetxt(os.path.join(d, "rank.test"), arr[split_r:], delimiter="\t", fmt="%.6g")
+    with open(os.path.join(d, "rank.train.query"), "w") as fh:
+        fh.write("\n".join(str(s) for s in sizes[:split_q]))
+    with open(os.path.join(d, "rank.test.query"), "w") as fh:
+        fh.write("\n".join(str(s) for s in sizes[split_q:]))
+    print(os.path.join(d, "rank.train"), arr.shape)
+
+
+def parallel(seed=3):
+    # same shape as binary_classification; both machines read the same
+    # file and the loader partitions rows by rank
+    rng = np.random.RandomState(seed)
+    n, f = 4000, 12
+    X = rng.randn(n, f)
+    y = (X[:, 0] + X[:, 1] > 0).astype(int)
+    d = os.path.join(HERE, "parallel_learning")
+    write(os.path.join(d, "binary.train"), y, X)
+
+
+if __name__ == "__main__":
+    binary()
+    regression()
+    lambdarank()
+    parallel()

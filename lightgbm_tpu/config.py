@@ -41,8 +41,9 @@ ALIAS_TABLE: Dict[str, str] = {
     "is_sparse": "is_enable_sparse",
     "enable_sparse": "is_enable_sparse",
     "pre_partition": "is_pre_partition",
-    "training_metric": "is_training_metric",
-    "train_metric": "is_training_metric",
+    "training_metric": "is_provide_training_metric",
+    "train_metric": "is_provide_training_metric",
+    "is_training_metric": "is_provide_training_metric",
     "ndcg_at": "ndcg_eval_at",
     "eval_at": "ndcg_eval_at",
     "min_data_per_leaf": "min_data_in_leaf",
