@@ -23,9 +23,9 @@ import time
 
 import numpy as np
 
-N_ROWS = int(os.environ.get("BENCH_ROWS", 500_000))
+N_ROWS = int(os.environ.get("BENCH_ROWS", 2_000_000))
 N_FEATURES = 28
-N_ITERS = int(os.environ.get("BENCH_ITERS", 20))
+N_ITERS = int(os.environ.get("BENCH_ITERS", 15))
 NUM_LEAVES = 255
 MAX_BIN = 63
 

@@ -681,11 +681,13 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         return state, l, new_leaf
 
     def round_body(state: TreeGrowerState) -> TreeGrowerState:
-        best_leaf = jnp.argmax(state.best_gain).astype(jnp.int32)
-        state = jax.lax.cond(
-            (state.best_gain[best_leaf] > 0.0)
-            & ~state.child_ready[best_leaf],
-            prefetch, lambda s: s, state)
+        # prefetch unconditionally: the argmax leaf is un-prefetched at
+        # the start of almost every round (the inner loop below drains
+        # ready leaves), and skipping the lax.cond keeps the [N]-sized
+        # state flowing straight through the while-loop body. top_k
+        # returns only pending leaves, so a rare redundant prefetch
+        # re-selects nothing (sel = all-L padding)
+        state = prefetch(state)
 
         def inner(j, carry):
             state, rec_l, rec_n = carry

@@ -140,9 +140,28 @@ def batched_children_histogram(binned: jnp.ndarray, weights: jnp.ndarray,
         m2k = jnp.concatenate(
             [member & bit_chunk[:, None], member & ~bit_chunk[:, None]],
             axis=1)                                           # [C, 2K]
-        u = (m2k[:, :, None].astype(jnp.float32)
-             * w_chunk[:, None, :]).reshape(chunk, 2 * k * 3)
-        return _contract(_onehot(b_chunk, num_bins), u, bf16)  # [F,B,2K*3]
+        oh = _onehot(b_chunk, num_bins)
+        if not bf16:
+            u = (m2k[:, :, None].astype(jnp.float32)
+                 * w_chunk[:, None, :]).reshape(chunk, 2 * k * 3)
+            return _contract(oh, u, False)                    # [F,B,2K*3]
+        # bf16 hi+lo in ONE contraction: the count channel's values are
+        # 0/1 (bf16-exact, lo == 0), so the lo correction needs only the
+        # grad/hess channels — 2K*3 hi + 2K*2 lo channels ride a single
+        # MXU pass (<= 128 output lanes for K <= 12) instead of two
+        # full-width passes
+        hi, lo = _hi_lo(w_chunk)                              # [C, 3]
+        m2kb = m2k[:, :, None].astype(jnp.bfloat16)
+        u_hi = (m2kb * hi[:, None, :]).reshape(chunk, 2 * k * 3)
+        u_lo = (m2kb[:, :, 0:2] * lo[:, None, 0:2]
+                ).reshape(chunk, 2 * k * 2)
+        u = jnp.concatenate([u_hi, u_lo], axis=1)
+        both = jnp.einsum("cfb,cs->fbs", oh.astype(jnp.bfloat16), u,
+                          preferred_element_type=jnp.float32)
+        main = both[:, :, :2 * k * 3].reshape(f, num_bins, 2 * k, 3)
+        corr = both[:, :, 2 * k * 3:].reshape(f, num_bins, 2 * k, 2)
+        return (main.at[:, :, :, 0:2].add(corr)
+                .reshape(f, num_bins, 2 * k * 3))
 
     if n_chunks == 1:
         hist = one(binned_c[0], w_c[0], lid_c[0], bit_c[0])

@@ -15,6 +15,10 @@ this box may expose fewer cores than the reference's benchmark setup
 baseline would flatter vs_baseline. REFERENCE_8T_FLOOR is the 8-thread
 measurement of this exact workload recorded in round 1's review
 (VERDICT.md: 20.2 s train on 500k x 28 x 20 iters = 0.495 mrow_iters/s).
+
+MUST run on an otherwise-idle machine: this box exposes ONE cpu to the
+process, and a concurrently-running test suite silently tripled the
+reference's per-iteration time in round 2's first measurement.
 """
 from __future__ import annotations
 

@@ -284,3 +284,33 @@ def test_c_abi_shim(tmp_path):
     assert b"Invalid handle" in lib.LGBM_GetLastError()
     lib.LGBM_BoosterFree(bh)
     lib.LGBM_DatasetFree(h)
+
+
+def test_eval_and_feature_names_copied_into_caller_buffers():
+    """Get*Names must strcpy into CALLER-allocated buffers (the reference
+    contract, c_api.cpp:272-289) — not swap the pointers."""
+    X, y = _make_mat(120, 3, seed=5)
+    h = _dataset_from_mat(X, y)
+    bh = _vp()
+    assert capi.LGBM_BoosterCreate(
+        h, ctypes.c_char_p(b"objective=binary metric=auc verbose=-1"),
+        ctypes.addressof(bh)) == 0
+    fin = ctypes.c_int(0)
+    capi.LGBM_BoosterUpdateOneIter(bh, ctypes.addressof(fin))
+
+    bufs = [ctypes.create_string_buffer(64) for _ in range(8)]
+    slots = (ctypes.c_char_p * 8)(*[ctypes.cast(b, ctypes.c_char_p)
+                                    for b in bufs])
+    out_len = ctypes.c_int(0)
+    assert capi.LGBM_BoosterGetEvalNames(
+        bh, ctypes.addressof(out_len), ctypes.addressof(slots)) == 0
+    assert out_len.value >= 1
+    # the CALLER buffer itself received the bytes
+    assert bufs[0].value == b"auc"
+
+    assert capi.LGBM_BoosterGetFeatureNames(
+        bh, ctypes.addressof(out_len), ctypes.addressof(slots)) == 0
+    assert out_len.value == 3
+    assert bufs[0].value.startswith(b"Column_")
+    capi.LGBM_BoosterFree(bh)
+    capi.LGBM_DatasetFree(h)

@@ -308,3 +308,23 @@ def test_pred_early_stop(breast_cancer):
     one_iter = gbm.predict(X, raw_score=True, num_iteration=1)
     np.testing.assert_allclose(stopped, one_iter, rtol=1e-6)
     assert not np.allclose(full, stopped)
+
+
+def test_pred_early_stop_multiclass():
+    """Multiclass early stop freezes rows whose top1-top2 margin clears
+    the threshold (prediction_early_stop.cpp:22-48)."""
+    rng = np.random.RandomState(3)
+    X = rng.randn(300, 5)
+    y = (X[:, 0] > 0.5).astype(int) + (X[:, 1] > 0).astype(int)
+    params = {"objective": "multiclass", "num_class": 3, "verbose": -1,
+              "num_leaves": 7, "min_data_in_leaf": 5}
+    gbm = lgb.train(params, lgb.Dataset(X, y), num_boost_round=10,
+                    verbose_eval=False)
+    full = gbm.predict(X, raw_score=True)
+    same = gbm.predict(X, raw_score=True, pred_early_stop=True,
+                       pred_early_stop_freq=3, pred_early_stop_margin=1e30)
+    np.testing.assert_allclose(full, same, rtol=1e-6)
+    stopped = gbm.predict(X, raw_score=True, pred_early_stop=True,
+                          pred_early_stop_freq=1, pred_early_stop_margin=0.0)
+    one = gbm.predict(X, raw_score=True, num_iteration=1)
+    np.testing.assert_allclose(stopped, one, rtol=1e-6)

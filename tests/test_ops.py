@@ -236,3 +236,28 @@ def test_leaf_output_formula():
     assert float(leaf_output(4.0, 2.0, 1.0, 1.0)) == pytest.approx(-1.0)
     assert float(leaf_output(-4.0, 2.0, 1.0, 1.0)) == pytest.approx(1.0)
     assert float(leaf_output(0.5, 2.0, 1.0, 0.0)) == pytest.approx(0.0)
+
+
+def test_batched_children_histogram_bf16_single_pass():
+    """The fused hi+lo bf16 contraction must stay within f32-ish tolerance
+    and keep counts EXACT (0/1 values are bf16-representable)."""
+    from lightgbm_tpu.ops.histogram import batched_children_histogram
+    rng = np.random.RandomState(7)
+    n, f, B, K = 512, 4, 16, 4
+    binned = rng.randint(0, B, size=(n, f)).astype(np.uint8)
+    g = rng.randn(n).astype(np.float32)
+    h = rng.rand(n).astype(np.float32)
+    w = np.stack([g, h, np.ones(n, np.float32)], axis=1)
+    leaf_id = rng.randint(0, 6, size=n).astype(np.int32)
+    split_bit = rng.rand(n) < 0.5
+    leaves = np.asarray([0, 2, 3, 5], np.int32)
+    ref = np.asarray(batched_children_histogram(
+        jnp.asarray(binned), jnp.asarray(w), jnp.asarray(leaf_id),
+        jnp.asarray(split_bit), jnp.asarray(leaves), B, chunk=128,
+        bf16=False))
+    fast = np.asarray(batched_children_histogram(
+        jnp.asarray(binned), jnp.asarray(w), jnp.asarray(leaf_id),
+        jnp.asarray(split_bit), jnp.asarray(leaves), B, chunk=128,
+        bf16=True))
+    np.testing.assert_allclose(fast, ref, rtol=2e-4, atol=2e-4)
+    np.testing.assert_array_equal(fast[:, :, :, 2], ref[:, :, :, 2])
