@@ -95,7 +95,9 @@ class GrowerConfig(NamedTuple):
     data_axis: Optional[str] = None
     feature_axis: Optional[str] = None
     num_feature_shards: int = 1
-    batch_k: int = 16
+    # K <= 12 keeps the fused bf16 histogram in one 128-lane MXU tile
+    # (ops/histogram.py); 8 measured best end-to-end
+    batch_k: int = 8
     hist_bf16: bool = True
     feature_bins: int = 0
     # voting-parallel (PV-tree, voting_parallel_tree_learner.cpp): with

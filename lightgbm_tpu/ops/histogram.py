@@ -20,15 +20,18 @@ keeps 10M-row sums within tolerance).
 
 Two performance levers over the naive contraction:
 - `bf16=True` runs the MXU in bf16 with the weights split into hi+lo
-  bf16 halves (two accumulating passes). The one-hot and the count channel
-  are exactly representable in bf16; grad/hess recover ~16 mantissa bits,
-  within f32 round-off of the true sum, at 2-4x the f32 contraction rate.
+  bf16 halves, FUSED into a single contraction: the count channel's 0/1
+  values are bf16-exact (lo == 0), so the lo correction rides along as
+  2 extra grad/hess channels per child slot. grad/hess recover ~16
+  mantissa bits — within f32 round-off of the true sum — at bf16 MXU
+  rates.
 - `batched_children_histogram` builds BOTH children's histograms of K
   splitting leaves in ONE pass by widening the contraction's output
-  dimension from 3 channels to 2*K*3 — the MXU is utilization-bound on
-  that dimension, so 2K histograms cost barely more than one. This is
-  what makes priority-batched growth (learner/grow.py) O(N * passes/K)
-  instead of O(N * leaves), with no parent histogram state at all.
+  dimension from 3 to 2K*3 (+2K*2 lo-correction) channels — the MXU is
+  utilization-bound on that dimension, so everything fits one 128-lane
+  output tile for K <= 12. This is what makes priority-batched growth
+  (learner/grow.py) O(N * passes/K) instead of O(N * leaves), with no
+  parent histogram state at all.
 """
 from __future__ import annotations
 
@@ -119,11 +122,12 @@ def batched_children_histogram(binned: jnp.ndarray, weights: jnp.ndarray,
     cached best split (computed by the grower's routing step). Output
     [2K, F, B, 3]: slot k is the LEFT child of leaves[k], slot K+k the
     RIGHT child. The contraction's output dim widens from 3 to 2K*3
-    channels — the MXU is utilization-bound there (2K*3 <= 128 for
-    K <= 21 costs the same as 3), so both children of K leaves cost one
-    pass, replacing the reference's smaller-child pass + parent-minus
-    subtraction (serial_tree_learner.cpp:349-363, 482-487) without
-    keeping any parent histogram state at all.
+    (+2K*2 bf16 lo-correction) channels — the MXU is utilization-bound
+    there, and everything fits ONE 128-lane output tile for K <= 12 —
+    so both children of K leaves cost one pass, replacing the
+    reference's smaller-child pass + parent-minus subtraction
+    (serial_tree_learner.cpp:349-363, 482-487) without keeping any
+    parent histogram state at all.
     """
     n, f = binned.shape
     if n % chunk != 0:

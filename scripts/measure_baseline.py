@@ -65,7 +65,9 @@ def main():
 
     exe = build_reference()
     X, y = synth_higgs(N_ROWS, N_FEATURES)
-    data_path = os.path.join(BUILD_DIR, "bench.train")
+    # the row count keys the cache: a BENCH_ROWS change must not silently
+    # reuse a stale dataset while the throughput math uses the new count
+    data_path = os.path.join(BUILD_DIR, f"bench_{N_ROWS}.train")
     if not os.path.exists(data_path):
         arr = np.column_stack([y, X])
         np.savetxt(data_path, arr, fmt="%.6g", delimiter="\t")
@@ -86,8 +88,9 @@ def main():
     if not os.path.exists(bin_path):
         subprocess.run([exe, f"data={data_path}", "task=train", "num_trees=1",
                         f"max_bin={MAX_BIN}", "save_binary=true",
-                        "objective=binary", "min_data_in_leaf=1"],
-                       check=True, capture_output=True)
+                        "objective=binary", "min_data_in_leaf=1",
+                        f"output_model={os.path.join(BUILD_DIR, 'warm_model.txt')}"],
+                       check=True, capture_output=True, cwd=BUILD_DIR)
     conf["data"] = bin_path
     args = [exe] + [f"{k}={v}" for k, v in conf.items()]
 
