@@ -70,6 +70,8 @@ class Dataset:
         self.used_indices: Optional[np.ndarray] = None
         self._predictor = None
         self._constructed_max_bin: Optional[int] = None
+        # pre-computed BinMappers (C API sampled-column streaming path)
+        self._preset_mappers = None
 
     @classmethod
     def _from_inner(cls, inner) -> "Dataset":
@@ -91,6 +93,7 @@ class Dataset:
         ds.used_indices = None
         ds._predictor = None
         ds._constructed_max_bin = inner.max_bin
+        ds._preset_mappers = None
         return ds
 
     def _update_params(self, params: Dict[str, Any]) -> "Dataset":
@@ -199,7 +202,8 @@ class Dataset:
             enable_bundle=(_parse_value(params.get("enable_bundle", True), bool)
                            and params.get("tree_learner", "serial") != "feature"),
             max_conflict_rate=float(params.get("max_conflict_rate", 0.0)),
-            sparse_threshold=float(params.get("sparse_threshold", 0.8)))
+            sparse_threshold=float(params.get("sparse_threshold", 0.8)),
+            mappers=self._preset_mappers)
         self._constructed_max_bin = max_bin
         return self._inner
 

@@ -135,7 +135,8 @@ class Dataset:
                    keep_raw: bool = False,
                    enable_bundle: bool = True,
                    max_conflict_rate: float = 0.0,
-                   sparse_threshold: float = 0.8) -> "Dataset":
+                   sparse_threshold: float = 0.8,
+                   mappers: Optional[List[BinMapper]] = None) -> "Dataset":
         """Build a Dataset from a dense float matrix.
 
         When `reference` is given, its BinMappers are reused so validation
@@ -159,6 +160,13 @@ class Dataset:
             ds.mappers = reference.mappers
             ds.used_features = reference.used_features
             ds.groups = reference.groups
+        elif mappers is not None:
+            # pre-computed BinMappers (C API sampled-column / push-rows
+            # streaming path, c_api.h:67-141: bins come from the sample,
+            # rows arrive later)
+            ds.mappers = list(mappers)
+            ds.used_features = [j for j, m in enumerate(ds.mappers)
+                                if not m.is_trivial]
         else:
             ds.mappers = find_bin_mappers(
                 data.astype(np.float64, copy=False), max_bin, min_data_in_bin,

@@ -23,6 +23,23 @@ FUNCS = [
       ("int32_t", "ncol"), ("int", "is_row_major"),
       ("const char*", "parameters"), ("const void*", "reference"),
       ("void**", "out")]),
+    ("LGBM_DatasetCreateFromSampledColumn",
+     [("double**", "sample_data"), ("int**", "sample_indices"),
+      ("int32_t", "ncol"), ("const int*", "num_per_col"),
+      ("int32_t", "num_sample_row"), ("int32_t", "num_total_row"),
+      ("const char*", "parameters"), ("void**", "out")]),
+    ("LGBM_DatasetCreateByReference",
+     [("const void*", "reference"), ("int64_t", "num_total_row"),
+      ("void**", "out")]),
+    ("LGBM_DatasetPushRows",
+     [("void*", "dataset"), ("const void*", "data"), ("int", "data_type"),
+      ("int32_t", "nrow"), ("int32_t", "ncol"), ("int32_t", "start_row")]),
+    ("LGBM_DatasetPushRowsByCSR",
+     [("void*", "dataset"), ("const void*", "indptr"),
+      ("int", "indptr_type"), ("const int32_t*", "indices"),
+      ("const void*", "data"), ("int", "data_type"),
+      ("int64_t", "nindptr"), ("int64_t", "nelem"), ("int64_t", "num_col"),
+      ("int64_t", "start_row")]),
     ("LGBM_DatasetCreateFromCSR",
      [("const void*", "indptr"), ("int", "indptr_type"),
       ("const int32_t*", "indices"), ("const void*", "data"),

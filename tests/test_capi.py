@@ -314,3 +314,54 @@ def test_eval_and_feature_names_copied_into_caller_buffers():
     assert bufs[0].value.startswith(b"Column_")
     capi.LGBM_BoosterFree(bh)
     capi.LGBM_DatasetFree(h)
+
+
+def test_push_rows_streaming():
+    """CreateFromSampledColumn + PushRows streaming (c_api.h:67-117):
+    mappers from the sample, rows in chunks, FinishLoad on the last
+    chunk — trained model must match the direct-matrix path."""
+    X, y = _make_mat(300, 4, seed=7)
+    ncol, n = 4, 300
+    # per-column samples = the full columns (sample == population)
+    cols = [np.ascontiguousarray(X[:, j]) for j in range(ncol)]
+    col_ptrs = (ctypes.c_void_p * ncol)(*[c.ctypes.data for c in cols])
+    idxs = [np.arange(n, dtype=np.int32) for _ in range(ncol)]
+    idx_ptrs = (ctypes.c_void_p * ncol)(*[i.ctypes.data for i in idxs])
+    counts = np.full(ncol, n, np.int32)
+    h = _vp()
+    rc = capi.LGBM_DatasetCreateFromSampledColumn(
+        ctypes.addressof(col_ptrs), ctypes.addressof(idx_ptrs), ncol,
+        counts.ctypes.data, n, n, ctypes.c_char_p(b"max_bin=31"),
+        ctypes.addressof(h))
+    assert rc == 0, capi.LGBM_GetLastError()
+    # label can arrive before the rows finish (stashed until FinishLoad)
+    assert capi.LGBM_DatasetSetField(
+        h, ctypes.c_char_p(b"label"), y.ctypes.data, n,
+        capi.C_API_DTYPE_FLOAT32) == 0
+    # push in 3 chunks
+    for lo in (0, 100, 200):
+        chunk = np.ascontiguousarray(X[lo:lo + 100])
+        assert capi.LGBM_DatasetPushRows(
+            h, chunk.ctypes.data, capi.C_API_DTYPE_FLOAT64, 100, ncol,
+            lo) == 0, capi.LGBM_GetLastError()
+    out = ctypes.c_int(0)
+    assert capi.LGBM_DatasetGetNumData(h, ctypes.addressof(out)) == 0
+    assert out.value == n
+
+    bh = _vp()
+    assert capi.LGBM_BoosterCreate(
+        h, ctypes.c_char_p(b"objective=binary verbose=-1 num_leaves=7"),
+        ctypes.addressof(bh)) == 0, capi.LGBM_GetLastError()
+    fin = ctypes.c_int(0)
+    for _ in range(5):
+        assert capi.LGBM_BoosterUpdateOneIter(bh, ctypes.addressof(fin)) == 0
+    preds = (ctypes.c_double * n)()
+    plen = ctypes.c_int64(0)
+    assert capi.LGBM_BoosterPredictForMat(
+        bh, X.ctypes.data, capi.C_API_DTYPE_FLOAT64, n, ncol, 1,
+        capi.C_API_PREDICT_NORMAL, -1, ctypes.addressof(plen),
+        ctypes.addressof(preds)) == 0
+    acc = np.mean((np.ctypeslib.as_array(preds) > 0.5) == y)
+    assert acc > 0.85, acc
+    capi.LGBM_BoosterFree(bh)
+    capi.LGBM_DatasetFree(h)

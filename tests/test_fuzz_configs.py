@@ -1,0 +1,69 @@
+"""Config-interaction fuzz: random-but-seeded parameter combinations must
+train, predict, and save/load without crashing (the reference's coverage
+here is its Python test matrix; this goes wider by sampling the product
+space of boosting x sampling x regularization x data quirks)."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+SEEDS = list(range(12))
+
+
+def _sample_config(rng):
+    objective = rng.choice(["regression", "binary", "multiclass",
+                            "regression_l1", "huber", "poisson"])
+    params = {
+        "objective": str(objective),
+        "verbose": -1,
+        "num_leaves": int(rng.choice([2, 7, 31])),
+        "max_bin": int(rng.choice([7, 31, 63])),
+        "min_data_in_leaf": int(rng.choice([1, 5, 40])),
+        "learning_rate": float(rng.choice([0.05, 0.3])),
+        "lambda_l1": float(rng.choice([0.0, 1.0])),
+        "lambda_l2": float(rng.choice([0.0, 10.0])),
+        "max_depth": int(rng.choice([-1, 3])),
+        "feature_fraction": float(rng.choice([1.0, 0.6])),
+        "boosting": str(rng.choice(["gbdt", "dart", "goss"])),
+        "min_gain_to_split": float(rng.choice([0.0, 0.5])),
+    }
+    if params["boosting"] == "gbdt" and rng.rand() < 0.5:
+        params["bagging_fraction"] = 0.7
+        params["bagging_freq"] = 2
+    if params["objective"] == "multiclass":
+        params["num_class"] = 3
+    return params
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_random_config_trains(seed):
+    rng = np.random.RandomState(seed)
+    params = _sample_config(rng)
+    n, f = 400, 6
+    X = rng.randn(n, f)
+    if rng.rand() < 0.5:
+        X[rng.rand(n, f) < 0.1] = np.nan       # missing values
+    if rng.rand() < 0.5:
+        X[:, 2] = rng.randint(0, 5, n)          # low-cardinality int col
+    if params["objective"] == "multiclass":
+        y = rng.randint(0, 3, n)
+    elif params["objective"] == "binary":
+        y = (X[:, 0] > 0).astype(float)
+        y[np.isnan(X[:, 0])] = 0.0
+    elif params["objective"] == "poisson":
+        y = rng.poisson(2.0, n).astype(float)
+    else:
+        y = np.nan_to_num(X[:, 0]) + 0.1 * rng.randn(n)
+    weight = rng.rand(n) + 0.5 if rng.rand() < 0.3 else None
+
+    ds = lgb.Dataset(X, y, weight=weight, params=dict(params))
+    booster = lgb.train(dict(params), ds, num_boost_round=5,
+                        verbose_eval=False)
+    preds = booster.predict(X)
+    assert np.isfinite(np.asarray(preds)).all()
+    # text round-trip survives
+    text = booster.model_to_string()
+    re = lgb.Booster(model_str=text)
+    p2 = re.predict(X)
+    np.testing.assert_allclose(np.asarray(preds), np.asarray(p2),
+                               rtol=1e-5, atol=1e-6)
