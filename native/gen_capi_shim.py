@@ -108,7 +108,8 @@ FUNCS = [
     ("LGBM_BoosterPredictForFile",
      [("void*", "handle"), ("const char*", "data_filename"),
       ("int", "data_has_header"), ("int", "predict_type"),
-      ("int", "num_iteration"), ("const char*", "result_filename")]),
+      ("int", "num_iteration"), ("const char*", "parameter"),
+      ("const char*", "result_filename")]),
     ("LGBM_BoosterCalcNumPredict",
      [("void*", "handle"), ("int", "num_row"), ("int", "predict_type"),
       ("int", "num_iteration"), ("int64_t*", "out_len")]),
@@ -117,20 +118,21 @@ FUNCS = [
       ("const int32_t*", "indices"), ("const void*", "data"),
       ("int", "data_type"), ("int64_t", "nindptr"), ("int64_t", "nelem"),
       ("int64_t", "num_col"), ("int", "predict_type"),
-      ("int", "num_iteration"), ("int64_t*", "out_len"),
-      ("double*", "out_result")]),
+      ("int", "num_iteration"), ("const char*", "parameter"),
+      ("int64_t*", "out_len"), ("double*", "out_result")]),
     ("LGBM_BoosterPredictForCSC",
      [("void*", "handle"), ("const void*", "col_ptr"), ("int", "col_ptr_type"),
       ("const int32_t*", "indices"), ("const void*", "data"),
       ("int", "data_type"), ("int64_t", "ncol_ptr"), ("int64_t", "nelem"),
       ("int64_t", "num_row"), ("int", "predict_type"),
-      ("int", "num_iteration"), ("int64_t*", "out_len"),
-      ("double*", "out_result")]),
+      ("int", "num_iteration"), ("const char*", "parameter"),
+      ("int64_t*", "out_len"), ("double*", "out_result")]),
     ("LGBM_BoosterPredictForMat",
      [("void*", "handle"), ("const void*", "data"), ("int", "data_type"),
       ("int32_t", "nrow"), ("int32_t", "ncol"), ("int", "is_row_major"),
       ("int", "predict_type"), ("int", "num_iteration"),
-      ("int64_t*", "out_len"), ("double*", "out_result")]),
+      ("const char*", "parameter"), ("int64_t*", "out_len"),
+      ("double*", "out_result")]),
     ("LGBM_BoosterSaveModel",
      [("void*", "handle"), ("int", "num_iteration"),
       ("const char*", "filename")]),

@@ -124,8 +124,8 @@ def test_booster_train_eval_predict_roundtrip(tmp_path):
     plen = ctypes.c_int64(0)
     rc = capi.LGBM_BoosterPredictForMat(
         bh, X.ctypes.data, capi.C_API_DTYPE_FLOAT64, X.shape[0], X.shape[1],
-        1, capi.C_API_PREDICT_NORMAL, -1, ctypes.addressof(plen),
-        ctypes.addressof(preds))
+        1, capi.C_API_PREDICT_NORMAL, -1, ctypes.c_char_p(b""),
+        ctypes.addressof(plen), ctypes.addressof(preds))
     assert rc == 0, capi.LGBM_GetLastError()
     p = np.ctypeslib.as_array(preds)
     acc = np.mean((p > 0.5) == y)
@@ -147,8 +147,8 @@ def test_booster_train_eval_predict_roundtrip(tmp_path):
     preds2 = (ctypes.c_double * X.shape[0])()
     capi.LGBM_BoosterPredictForMat(
         bh2, X.ctypes.data, capi.C_API_DTYPE_FLOAT64, X.shape[0], X.shape[1],
-        1, capi.C_API_PREDICT_NORMAL, -1, ctypes.addressof(plen),
-        ctypes.addressof(preds2))
+        1, capi.C_API_PREDICT_NORMAL, -1, ctypes.c_char_p(b""),
+        ctypes.addressof(plen), ctypes.addressof(preds2))
     np.testing.assert_allclose(np.ctypeslib.as_array(preds2), p, rtol=1e-6)
 
     # save to file + create from model file
@@ -192,8 +192,8 @@ def test_booster_custom_objective_update():
         plen = ctypes.c_int64(0)
         capi.LGBM_BoosterPredictForMat(
             bh, X.ctypes.data, capi.C_API_DTYPE_FLOAT64, 200, 4, 1,
-            capi.C_API_PREDICT_RAW_SCORE, -1, ctypes.addressof(plen),
-            ctypes.addressof(preds))
+            capi.C_API_PREDICT_RAW_SCORE, -1, ctypes.c_char_p(b""),
+            ctypes.addressof(plen), ctypes.addressof(preds))
         score = np.ctypeslib.as_array(preds).copy()
     acc = np.mean((score > 0) == y)
     assert acc > 0.85
@@ -224,7 +224,7 @@ def test_dataset_from_file_and_predict_for_file(tmp_path):
     rpath = str(tmp_path / "capi_preds.txt")
     rc = capi.LGBM_BoosterPredictForFile(
         bh, ctypes.c_char_p(path.encode()), 0, capi.C_API_PREDICT_NORMAL,
-        -1, ctypes.c_char_p(rpath.encode()))
+        -1, ctypes.c_char_p(b""), ctypes.c_char_p(rpath.encode()))
     assert rc == 0, capi.LGBM_GetLastError()
     preds = np.loadtxt(rpath)
     assert preds.shape == (150,)
@@ -273,8 +273,8 @@ def test_c_abi_shim(tmp_path):
     plen = ctypes.c_int64(0)
     assert lib.LGBM_BoosterPredictForMat(
         bh, ctypes.c_void_p(X.ctypes.data), capi.C_API_DTYPE_FLOAT64,
-        200, 5, 1, capi.C_API_PREDICT_NORMAL, -1, ctypes.byref(plen),
-        ctypes.byref(preds)) == 0
+        200, 5, 1, capi.C_API_PREDICT_NORMAL, -1, ctypes.c_char_p(b""),
+        ctypes.byref(plen), ctypes.byref(preds)) == 0
     p = np.ctypeslib.as_array(preds)
     assert np.mean((p > 0.5) == y) > 0.85
     # error path surfaces through LGBM_GetLastError
@@ -359,8 +359,8 @@ def test_push_rows_streaming():
     plen = ctypes.c_int64(0)
     assert capi.LGBM_BoosterPredictForMat(
         bh, X.ctypes.data, capi.C_API_DTYPE_FLOAT64, n, ncol, 1,
-        capi.C_API_PREDICT_NORMAL, -1, ctypes.addressof(plen),
-        ctypes.addressof(preds)) == 0
+        capi.C_API_PREDICT_NORMAL, -1, ctypes.c_char_p(b""),
+        ctypes.addressof(plen), ctypes.addressof(preds)) == 0
     acc = np.mean((np.ctypeslib.as_array(preds) > 0.5) == y)
     assert acc > 0.85, acc
     capi.LGBM_BoosterFree(bh)
