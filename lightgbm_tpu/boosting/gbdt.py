@@ -207,10 +207,17 @@ class GBDT:
                 from jax.experimental import multihost_utils
 
                 def _allreduce_sum(arr):
+                    # f64 end-to-end: the reference Allreduces doubles
+                    # (gbdt.cpp BoostFromAverage) and a 10M-row label sum
+                    # loses real precision in f32. jax defaults to x32,
+                    # so ship each double as (hi=f32, lo=residual-f32).
+                    a = np.asarray(arr, np.float64)
+                    hi = a.astype(np.float32)
+                    lo = (a - hi.astype(np.float64)).astype(np.float32)
                     g = multihost_utils.process_allgather(
-                        jnp.asarray(np.asarray(arr, np.float64)
-                                    .astype(np.float32)))
-                    return np.asarray(g, np.float64).sum(axis=0)
+                        jnp.stack([jnp.asarray(hi), jnp.asarray(lo)]))
+                    g = np.asarray(g, np.float64)  # [P, 2, ...]
+                    return (g[:, 0] + g[:, 1]).sum(axis=0)
 
                 objective.sync_distributed(_allreduce_sum)
             objective.pad_to(n_pad)

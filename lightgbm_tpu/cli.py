@@ -100,7 +100,9 @@ def _build_dataset(path: str, params: Dict, cfg: Config,
             enable_bundle=False,
             max_conflict_rate=cfg.io.max_conflict_rate,
             sparse_threshold=cfg.io.sparse_threshold)
-        return Dataset._from_inner(inner)
+        ds = Dataset._from_inner(inner)
+        return _load_sidecars(ds, path, inner.used_row_indices,
+                              num_global_rows=inner.num_global_rows)
     bin_path = _check_binary_dataset(path) \
         if cfg.io.enable_load_from_binary_file else None
     if bin_path is not None and reference is None:
@@ -127,19 +129,44 @@ def _build_dataset(path: str, params: Dict, cfg: Config,
         data, label = load_data_file(path, has_header=has_header)
         ds = Dataset(data, label=label, params=dict(params),
                      reference=reference)
-    weights = load_weight_file(path)
-    if weights is not None:
-        ds.set_weight(weights)
-    query = load_query_file(path)
-    if query is not None:
-        ds.set_group(query)
-    init_path = path + ".init"
-    if os.path.exists(init_path):
-        with open(init_path) as fh:
-            ds.set_init_score(np.asarray([float(x) for x in fh.read().split()]))
+    ds = _load_sidecars(ds, path, None)
     if cfg.io.is_save_binary_file and bin_path is None:
         ds.construct()
         ds._inner.save_binary(path + ".bin")
+    return ds
+
+
+def _load_sidecars(ds: Dataset, path: str, row_idx,
+                   num_global_rows: int = 0) -> Dataset:
+    """Attach .weight/.query/.init files. Under multi-process sharding
+    `row_idx` holds the global rows this rank owns; sidecar arrays cover
+    ALL global rows and are sliced to the local partition (queries are
+    already query-atomically assigned by the loader, which set the group
+    itself — reference: dataset_loader.cpp:159-217)."""
+    weights = load_weight_file(path)
+    if weights is not None:
+        ds.set_weight(weights if row_idx is None else weights[row_idx])
+    inner = getattr(ds, "_inner", None)
+    already_grouped = (inner is not None and
+                       inner.metadata.query_boundaries is not None)
+    if not already_grouped:
+        query = load_query_file(path)
+        if query is not None:
+            ds.set_group(query)
+    init_path = path + ".init"
+    if os.path.exists(init_path):
+        with open(init_path) as fh:
+            scores = np.asarray([float(x) for x in fh.read().split()])
+        if row_idx is not None:
+            # multiclass .init holds n_global*k values, class-major
+            # ([k, n] flattened — gbdt.py init_score layout); slice each
+            # class's column to the local rows
+            n = num_global_rows
+            if n and scores.size % n == 0 and scores.size != n:
+                scores = scores.reshape(-1, n)[:, row_idx].ravel()
+            else:
+                scores = scores[row_idx]
+        ds.set_init_score(scores)
     return ds
 
 

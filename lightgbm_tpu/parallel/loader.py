@@ -28,6 +28,15 @@ from .. import log
 from ..binning import BinMapper
 
 
+def query_owner(num_queries: int, num_machines: int,
+                seed: int = 1) -> np.ndarray:
+    """Owning rank per query — the single source of the query-assignment
+    RNG stream (both partition_rows and two_round_load must agree
+    bit-exactly or ranks would drop/duplicate rows)."""
+    return np.random.RandomState(seed).randint(0, num_machines,
+                                               size=num_queries)
+
+
 def partition_rows(num_rows: int, rank: int, num_machines: int,
                    query_boundaries: Optional[np.ndarray] = None,
                    seed: int = 1) -> np.ndarray:
@@ -37,15 +46,13 @@ def partition_rows(num_rows: int, rank: int, num_machines: int,
     QUERIES are assigned (lambdarank constraint: a query never straddles
     machines, dataset_loader.cpp:159-166, 580-598). Deterministic in
     `seed`, so every rank computes the same global assignment."""
-    rng = np.random.RandomState(seed)
     if query_boundaries is None:
+        rng = np.random.RandomState(seed)
         owner = rng.randint(0, num_machines, size=num_rows)
         return np.nonzero(owner == rank)[0]
     qb = np.asarray(query_boundaries)
-    nq = len(qb) - 1
-    owner_q = rng.randint(0, num_machines, size=nq)
-    sizes = np.diff(qb)
-    owner_row = np.repeat(owner_q, sizes)
+    owner_q = query_owner(len(qb) - 1, num_machines, seed)
+    owner_row = np.repeat(owner_q, np.diff(qb))
     return np.nonzero(owner_row == rank)[0]
 
 
@@ -56,16 +63,12 @@ def load_partition(path: str, rank: int, num_machines: int,
 
     Returns (data, label, used_indices, num_global_rows). Query files
     (`path + ".query"`) trigger query-atomic assignment."""
-    import os
-
-    from ..io.parser import load_data_file
+    from ..io.parser import load_data_file, load_query_file
     data, label = load_data_file(path, has_header=has_header)
     n = data.shape[0]
     qb = None
-    qpath = path + ".query"
-    if os.path.exists(qpath):
-        with open(qpath) as fh:
-            sizes = np.asarray([int(x) for x in fh.read().split()])
+    sizes = load_query_file(path)
+    if sizes is not None:
         qb = np.concatenate([[0], np.cumsum(sizes)])
         if qb[-1] != n:
             log.fatal("Query file rows (%d) != data rows (%d)"
@@ -227,15 +230,41 @@ def two_round_load(path: str, max_bin: int = 255, min_data_in_bin: int = 3,
     from ..efb import find_groups
 
     # round 1: reservoir sample + per-rank row ownership
+    from ..io.parser import load_query_file
+
     shard = shard_rows and num_machines > 1
+    qsizes = load_query_file(path)
+    owner_q = None
+    owner_row_global = None
+    if shard and qsizes is not None:
+        # query-atomic ownership — whole queries to one rank, same RNG
+        # stream as partition_rows (dataset_loader.cpp:580-598: a query
+        # must never straddle machines)
+        owner_q = query_owner(len(qsizes), num_machines, seed)
+        owner_row_global = np.repeat(owner_q, qsizes)
+
+    def chunk_mine(global_lo: int, n: int, stream) -> np.ndarray:
+        if not shard:
+            return np.ones(n, bool)
+        if owner_row_global is not None:
+            if global_lo + n > len(owner_row_global):
+                log.fatal("Query file covers %d rows but %s has more"
+                          % (len(owner_row_global), path))
+            return owner_row_global[global_lo:global_lo + n] == rank
+        return stream.randint(0, num_machines, size=n) == rank
+
     rng = np.random.RandomState(seed)
     reservoir: List[np.ndarray] = []
     seen = 0
     row_owner = np.random.RandomState(seed)  # same stream as partition_rows
     local_rows = 0
+    owned_chunks: List[np.ndarray] = []
+    global_lo = 0
     for block in iter_parsed_chunks(path, has_header, chunk_rows):
-        mine = row_owner.randint(0, num_machines, size=len(block)) == rank \
-            if shard else np.ones(len(block), bool)
+        mine = chunk_mine(global_lo, len(block), row_owner)
+        if shard:
+            owned_chunks.append(np.nonzero(mine)[0] + global_lo)
+        global_lo += len(block)
         local_block = block[mine]
         local_rows += len(local_block)
         for row in local_block:
@@ -246,6 +275,10 @@ def two_round_load(path: str, max_bin: int = 255, min_data_in_bin: int = 3,
                 j = rng.randint(0, seen)
                 if j < bin_construct_sample_cnt:
                     reservoir[j] = row
+    total_rows = global_lo
+    if qsizes is not None and int(qsizes.sum()) != total_rows:
+        log.fatal("Query file rows (%d) != data rows (%d)"
+                  % (int(qsizes.sum()), total_rows))
     if not reservoir:
         log.fatal("No rows for rank %d in %s" % (rank, path))
     sample_full = np.asarray(reservoir)
@@ -270,9 +303,10 @@ def two_round_load(path: str, max_bin: int = 255, min_data_in_bin: int = 3,
     labels = np.zeros(local_rows, np.float32)
     row_owner = np.random.RandomState(seed)
     lo = 0
+    global_lo = 0
     for block in iter_parsed_chunks(path, has_header, chunk_rows):
-        mine = row_owner.randint(0, num_machines, size=len(block)) == rank \
-            if shard else np.ones(len(block), bool)
+        mine = chunk_mine(global_lo, len(block), row_owner)
+        global_lo += len(block)
         block = block[mine]
         if not len(block):
             continue
@@ -302,4 +336,13 @@ def two_round_load(path: str, max_bin: int = 255, min_data_in_bin: int = 3,
     from ..dataset import Metadata
     ds.metadata = Metadata(local_rows)
     ds.metadata.set_label(labels)
+    # global row indices this rank owns — callers slice sidecar files
+    # (.weight/.init) to the local partition with these
+    ds.used_row_indices = (np.concatenate(owned_chunks)
+                           if owned_chunks else np.zeros(0, np.int64)) \
+        if shard else np.arange(local_rows, dtype=np.int64)
+    ds.num_global_rows = total_rows
+    if qsizes is not None:
+        local_q = qsizes[owner_q == rank] if owner_q is not None else qsizes
+        ds.metadata.set_group(local_q)
     return ds

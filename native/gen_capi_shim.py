@@ -180,6 +180,11 @@ static PyObject* capi_module(void) {
 static void ensure_python(void) {
     if (!Py_IsInitialized()) {
         Py_InitializeEx(0);
+        /* Py_InitializeEx leaves the GIL held by this thread; release it
+           so OTHER threads' PyGILState_Ensure calls don't deadlock
+           (concurrent PushRows ingestion is a supported use). Entry
+           points re-acquire via PyGILState_Ensure. */
+        PyEval_SaveThread();
     }
 }
 

@@ -365,3 +365,60 @@ def test_push_rows_streaming():
     assert acc > 0.85, acc
     capi.LGBM_BoosterFree(bh)
     capi.LGBM_DatasetFree(h)
+
+
+def test_get_field_group_returns_boundaries():
+    """SetField takes per-query SIZES, GetField returns cumulative
+    BOUNDARIES (nq+1 int32) — the reference's asymmetric contract; its
+    python package re-diffs the result (reference basic.py get_field)."""
+    X, y = _make_mat(60, 3, seed=5)
+    h = _dataset_from_mat(X, y)
+    sizes = np.asarray([10, 20, 30], np.int32)
+    rc = capi.LGBM_DatasetSetField(
+        h, ctypes.c_char_p(b"group"), sizes.ctypes.data, len(sizes),
+        capi.C_API_DTYPE_INT32)
+    assert rc == 0, capi.LGBM_GetLastError()
+    out_len = ctypes.c_int(0)
+    out_ptr = ctypes.c_void_p(0)
+    out_type = ctypes.c_int(-1)
+    rc = capi.LGBM_DatasetGetField(
+        h, ctypes.c_char_p(b"group"), ctypes.addressof(out_len),
+        ctypes.addressof(out_ptr), ctypes.addressof(out_type))
+    assert rc == 0, capi.LGBM_GetLastError()
+    assert out_len.value == 4  # nq + 1
+    assert out_type.value == capi.C_API_DTYPE_INT32
+    got = np.ctypeslib.as_array(
+        ctypes.cast(out_ptr, ctypes.POINTER(ctypes.c_int32)), shape=(4,))
+    np.testing.assert_array_equal(got, [0, 10, 30, 60])
+    capi.LGBM_DatasetFree(h)
+
+
+def test_save_model_to_string_truncation_semantics():
+    """When the buffer is too small, nothing is copied (reference
+    semantics) — out_len still reports the needed size for the retry."""
+    X, y = _make_mat(120, 4, seed=9)
+    d = _dataset_from_mat(X, y)
+    b = _vp()
+    rc = capi.LGBM_BoosterCreate(
+        d, ctypes.c_char_p(b"objective=binary num_leaves=7 min_data_in_leaf=5"),
+        ctypes.addressof(b))
+    assert rc == 0, capi.LGBM_GetLastError()
+    fin = ctypes.c_int(0)
+    for _ in range(2):
+        capi.LGBM_BoosterUpdateOneIter(b, ctypes.addressof(fin))
+    out_len = ctypes.c_int64(0)
+    sentinel = b"\xee" * 8
+    buf = ctypes.create_string_buffer(sentinel, 8)
+    rc = capi.LGBM_BoosterSaveModelToString(
+        b, -1, 8, ctypes.addressof(out_len), ctypes.addressof(buf))
+    assert rc == 0
+    assert out_len.value > 8
+    assert buf.raw == sentinel  # untouched: string didn't fit
+    big = ctypes.create_string_buffer(out_len.value)
+    rc = capi.LGBM_BoosterSaveModelToString(
+        b, -1, out_len.value, ctypes.addressof(out_len),
+        ctypes.addressof(big))
+    assert rc == 0
+    assert b"tree" in big.value
+    capi.LGBM_BoosterFree(b)
+    capi.LGBM_DatasetFree(d)

@@ -109,3 +109,52 @@ def test_iter_parsed_chunks_roundtrip(tmp_path):
     chunks = list(iter_parsed_chunks(path, chunk_rows=100))
     assert sum(len(c) for c in chunks) == 517
     np.testing.assert_allclose(np.vstack(chunks), np.loadtxt(path), rtol=1e-6)
+
+
+def test_two_round_load_query_atomic_sharding(tmp_path):
+    """With a .query sidecar, two-round sharding assigns WHOLE queries to
+    ranks (matching partition_rows), sets the local group, and exposes
+    the owned global row indices for sidecar slicing."""
+    from lightgbm_tpu.parallel.loader import partition_rows
+    rng = np.random.RandomState(7)
+    sizes = rng.randint(3, 9, size=40)
+    n, f = int(sizes.sum()), 4
+    X = rng.randn(n, f)
+    y = rng.randint(0, 3, size=n).astype(float)
+    path = str(tmp_path / "q.tsv")
+    np.savetxt(path, np.column_stack([y, X]), delimiter="\t", fmt="%.8g")
+    with open(path + ".query", "w") as fh:
+        fh.write("\n".join(str(s) for s in sizes))
+
+    qb = np.concatenate([[0], np.cumsum(sizes)])
+    seed = 1
+    all_idx = []
+    for r in range(3):
+        part = two_round_load(path, max_bin=15, chunk_rows=64, rank=r,
+                              num_machines=3, seed=seed)
+        idx = part.used_row_indices
+        np.testing.assert_array_equal(
+            idx, partition_rows(n, r, 3, query_boundaries=qb, seed=seed))
+        # local group sizes must be exactly the owned queries' sizes
+        local_qb = part.metadata.query_boundaries
+        assert local_qb is not None
+        assert local_qb[-1] == part.num_data == len(idx)
+        all_idx.append(idx)
+    covered = np.sort(np.concatenate(all_idx))
+    np.testing.assert_array_equal(covered, np.arange(n))
+
+
+def test_two_round_load_single_rank_sets_group(tmp_path):
+    rng = np.random.RandomState(8)
+    sizes = np.asarray([5, 7, 4])
+    n = int(sizes.sum())
+    X = rng.randn(n, 3)
+    y = rng.randint(0, 2, size=n).astype(float)
+    path = str(tmp_path / "g.tsv")
+    np.savetxt(path, np.column_stack([y, X]), delimiter="\t", fmt="%.8g")
+    with open(path + ".query", "w") as fh:
+        fh.write("\n".join(str(s) for s in sizes))
+    ds = two_round_load(path, max_bin=15, chunk_rows=8)
+    np.testing.assert_array_equal(np.diff(ds.metadata.query_boundaries),
+                                  sizes)
+    np.testing.assert_array_equal(ds.used_row_indices, np.arange(n))
