@@ -50,7 +50,7 @@ def _pad_to(arr: np.ndarray, n: int, value=0):
 
 
 # small-state fields fetched host-side to build the Tree (everything
-# from_grower_state reads — NOT leaf_id/split_bit/lbest/..., which stay
+# from_grower_state reads — NOT the [N]-sized leaf_id, which stays
 # on device)
 _SMALL_STATE_KEYS = (
     "num_leaves_used", "leaf_value", "count", "node_feature",
@@ -271,11 +271,15 @@ class GBDT:
                 self._dist_grower = FeatureParallelGrower(
                     mesh, self._grower_cfg, axis="feature")
                 binned_host, fm = self._dist_grower.pad_features(binned_host, fm)
+            elif self._tree_learner_kind == "voting":
+                mesh = make_mesh(axis_name="data")
+                self._dist_grower = VotingParallelGrower(
+                    mesh, self._grower_cfg, axis="data",
+                    top_k=self.config.tree.top_k)
             else:
                 mesh = make_mesh(axis_name="data")
-                cls = VotingParallelGrower if self._tree_learner_kind == "voting" \
-                    else DataParallelGrower
-                self._dist_grower = cls(mesh, self._grower_cfg, axis="data")
+                self._dist_grower = DataParallelGrower(
+                    mesh, self._grower_cfg, axis="data")
             log.info("Using %s-parallel tree learner over %d devices",
                      self._tree_learner_kind, ndev)
         if (self._tree_learner_kind == "feature"

@@ -4,45 +4,52 @@ TPU-native re-design of the reference SerialTreeLearner
 (`src/treelearner/serial_tree_learner.cpp:152-583`). The reference grows a
 tree with per-leaf dynamic row partitions (DataPartition), a histogram LRU
 pool, and host loops. Here the entire `num_leaves-1` split loop is ONE
-`lax.fori_loop` under jit with fixed shapes:
+`lax.while_loop` under jit with fixed shapes:
 
 - the row partition is a `leaf_id[N]` vector (no index shuffling; split
   application is a vectorized where — replaces data_partition.hpp:94-170);
-- all active-leaf histograms live in a dense `[L, F, B, 3]` HBM pool
-  (replaces the size-bounded HistogramPool, feature_histogram.hpp:380-548 —
-  HBM is plentiful, rematerialization unnecessary);
 - best-split finding is the vectorized [F, B] scan (ops/split.py) followed
   by an argmax over features, replacing per-feature OMP loops
   (serial_tree_learner.cpp:451-516).
 
-Histogram batching (the round-3 redesign): the reference touches only the
-smaller child's rows per split (dense_bin.hpp:66-133), which a fixed-shape
-masked reduction cannot — every pass costs O(N). Instead of one pass per
-split, we exploit that a leaf's cached best split fully determines its
-children's row sets BEFORE the leaf is committed: a single batched pass
-builds BOTH children's histograms of up to `batch_k` pending leaves at
-once (one-hot-over-bins x member-weights einsum whose MXU output dimension
-is 2*batch_k*3 channels instead of 3 — utilization-bound, so both children
-of K leaves cost one pass), and their best splits are cached
-parent-indexed. The sequential best-first commit loop is unchanged —
-trees are IDENTICAL to the one-pass-per-split grower — but a data pass
-happens only when the argmax leaf's children were not yet prefetched.
+Speculative expansion (the round-3 redesign). A full-N histogram pass has
+a HARD per-pass cost floor on TPU: the MXU's 128-lane output tile means a
+one-hot-over-bins contraction costs the same for 1 live channel as for
+128, and the measured floor (~4-7 ms at 2M rows x 28 features x 64 bins)
+is ~70% of the bf16 roofline — per-pass optimization is exhausted. What
+is NOT fixed is the NUMBER of passes. Round 2 ran one pass per "round"
+of the strict best-first commit loop (~91 passes per 255-leaf tree,
+~2.8 commits each) because child histograms were only built for leaves
+about to commit. The key fact this version exploits: building a leaf's
+children histograms needs only the leaf's CACHED best split — not its
+commit. So the grower speculatively expands the gain-priority frontier
+down the tree, decoupled from the commit order:
 
-Two structural rules keep the 254-iteration commit loop off the TPU's
-slow paths (profiled in round 2: per-iteration [N]-gathers and `lax.cond`
-copies of pooled histograms dominated everything):
-- NO histogram state survives across loop iterations. Children histograms
-  are consumed into cached best splits inside the prefetch; the
-  parent-minus-smaller subtraction (serial_tree_learner.cpp:482-487) is
-  replaced by building both children directly in the same pass.
-- NO per-row gathers inside the commit path. The prefetch stores each
-  routed row's go-left bit (`split_bit[N]`) using per-leaf DYNAMIC SLICES
-  of the transposed bin matrix (contiguous [G, N] rows) + scalar
-  broadcasts; a commit is then a pure elementwise where() on leaf_id.
+- a NODE TABLE of M = 4L + 2K + 2 slots holds every speculative node:
+  parent link, depth, aggregate (g, h, count), its cached best split,
+  and lifecycle bits (created/expanded/committed/frontier);
+- `leaf_id[N]` labels rows with the DEEPEST speculative node that owns
+  them; each expansion pass routes the rows of up to `batch_k` selected
+  nodes under their cached splits and relabels them to fresh child ids —
+  children histograms are then direct `leaf_id == child` masked
+  reductions (ops/histogram.batched_leaves_histogram);
+- selection is top-K by cached gain among unexpanded nodes, with the
+  commit-blocking frontier argmax force-included, so the strict order
+  can always make progress;
+- COMMITS touch only [M]/[L]-sized state: pop the frontier argmax,
+  write the tree node, promote the (already created) children to the
+  frontier. No data pass, no row updates. Trees are therefore
+  BIT-IDENTICAL to the sequential best-first grower for every batch_k —
+  speculation only precomputes work earlier (the same guarantee the
+  reference's HistogramPool gives: a pure cache never changes the tree,
+  feature_histogram.hpp:380-548).
 
-`lax.cond` keeps iterations after growth stops (all gains <= 0) nearly
-free. One compile per (N, F, B, L, hyperparam) signature, reused across
-trees and boosting iterations.
+Pass count drops from ~(commits / 2.8) to ~max(tree depth, commits / K):
+measured 91 -> ~30 per 255-leaf tree, with each pass's 2K*(3+2) output
+channels sized to fill the 128-lane MXU tile (batch_k=12 default).
+
+`num_leaves-1` commits, one compile per (N, F, B, L, hyperparam)
+signature, reused across trees and boosting iterations.
 """
 from __future__ import annotations
 
@@ -73,11 +80,11 @@ class GrowerConfig(NamedTuple):
       — replacing SyncUpGlobalBestSplit (parallel_tree_learner.h:184-207).
     - num_feature_shards: size of feature_axis (features must be padded to
       a multiple of it host-side).
-    - batch_k: number of pending leaves whose child histograms are built
-      per data pass (1 = the round-1 one-pass-per-split behavior).
+    - batch_k: number of nodes speculatively expanded per data pass
+      (1 = the one-pass-per-split sequential behavior). 2*batch_k*(3+2)
+      output channels ride one 128-lane MXU tile for batch_k <= 12.
     - hist_bf16: compute the histogram contraction with bf16 one-hot and
-      hi+lo-split bf16 weights (two MXU passes, ~f32-quality sums, roughly
-      2-4x faster than a true f32 contraction on TPU).
+      hi+lo-split bf16 weights (~f32-quality sums at bf16 MXU rates).
     - max_bins is the STORED-GROUP histogram width (after EFB bundling);
       feature_bins is the per-feature scan width for split finding
       (<= max_bins; 0 means use max_bins). With bundling disabled the two
@@ -95,9 +102,9 @@ class GrowerConfig(NamedTuple):
     data_axis: Optional[str] = None
     feature_axis: Optional[str] = None
     num_feature_shards: int = 1
-    # K <= 12 keeps the fused bf16 histogram in one 128-lane MXU tile
-    # (ops/histogram.py); 8 measured best end-to-end
-    batch_k: int = 8
+    # K <= 12 keeps the 2K*(3hi+2lo)-channel contraction in one 128-lane
+    # MXU output tile (ops/histogram.py)
+    batch_k: int = 12
     hist_bf16: bool = True
     feature_bins: int = 0
     # voting-parallel (PV-tree, voting_parallel_tree_learner.cpp): with
@@ -109,33 +116,15 @@ class GrowerConfig(NamedTuple):
 
 
 class TreeGrowerState(NamedTuple):
-    leaf_id: jnp.ndarray          # [N] i32 (-1 = padded/inactive row)
-    # split_bit[r]: go-left decision of row r under its CURRENT leaf's
-    # cached best split; written by the prefetch routing pass, consumed
-    # (elementwise, no gathers) by the commit. Valid whenever the row's
-    # leaf has child_ready set — exactly when a commit can touch it.
-    split_bit: jnp.ndarray        # [N] bool
-    # per-leaf aggregates [L]
+    """Public result of one tree growth (what GBDT / Tree export read)."""
+    leaf_id: jnp.ndarray          # [N] i32 committed LEAF SLOT per row
+    # per-leaf-slot aggregates [L]
     sum_g: jnp.ndarray
     sum_h: jnp.ndarray
     count: jnp.ndarray
     leaf_value: jnp.ndarray
     leaf_depth: jnp.ndarray
     leaf_parent: jnp.ndarray
-    # per-leaf best-split cache [L]
-    best_gain: jnp.ndarray
-    best_feature: jnp.ndarray
-    best_threshold: jnp.ndarray
-    best_default_left: jnp.ndarray
-    best_is_cat: jnp.ndarray
-    best_left_g: jnp.ndarray
-    best_left_h: jnp.ndarray
-    best_left_c: jnp.ndarray
-    # prefetch state: child_ready[l] = l's children best splits are
-    # cached (lbest/rbest, parent-indexed) and l's rows' split_bit is set
-    child_ready: jnp.ndarray      # [L] bool
-    lbest: "ChildBest"
-    rbest: "ChildBest"
     num_passes: jnp.ndarray       # scalar i32: data passes this tree
     comm_elems: jnp.ndarray       # scalar f32: elements moved through
                                   # cross-shard collectives this tree
@@ -152,9 +141,15 @@ class TreeGrowerState(NamedTuple):
     num_leaves_used: jnp.ndarray  # scalar i32
 
 
-class ChildBest(NamedTuple):
-    """Cached best split of a not-yet-committed child, parent-indexed [L]."""
-    gain: jnp.ndarray
+class _NodeTable(NamedTuple):
+    """Speculative node table, all arrays [M] (M = 4L + 2K + 2; slot M-1
+    is never allocated — out-of-range scatter indices use mode='drop')."""
+    parent: jnp.ndarray           # i32
+    depth: jnp.ndarray            # i32
+    sum_g: jnp.ndarray            # f32 node aggregates
+    sum_h: jnp.ndarray
+    count: jnp.ndarray
+    gain: jnp.ndarray             # cached best split of the node
     feature: jnp.ndarray
     threshold: jnp.ndarray
     default_left: jnp.ndarray
@@ -162,37 +157,37 @@ class ChildBest(NamedTuple):
     left_g: jnp.ndarray
     left_h: jnp.ndarray
     left_c: jnp.ndarray
+    created: jnp.ndarray          # bool lifecycle
+    expanded: jnp.ndarray
+    frontier: jnp.ndarray         # leaf of the COMMITTED tree
+    child_l: jnp.ndarray          # i32 spec children (valid iff expanded)
+    child_r: jnp.ndarray
+    leaf_slot: jnp.ndarray        # i32 committed leaf slot (frontier only)
 
     @classmethod
-    def zeros(cls, L):
+    def zeros(cls, m):
+        neg_inf = jnp.float32(-jnp.inf)
         return cls(
-            gain=jnp.full(L, -jnp.inf, jnp.float32),
-            feature=jnp.zeros(L, jnp.int32),
-            threshold=jnp.zeros(L, jnp.int32),
-            default_left=jnp.zeros(L, bool),
-            is_cat=jnp.zeros(L, bool),
-            left_g=jnp.zeros(L, jnp.float32),
-            left_h=jnp.zeros(L, jnp.float32),
-            left_c=jnp.zeros(L, jnp.float32),
+            parent=jnp.zeros(m, jnp.int32),
+            depth=jnp.zeros(m, jnp.int32),
+            sum_g=jnp.zeros(m, jnp.float32),
+            sum_h=jnp.zeros(m, jnp.float32),
+            count=jnp.zeros(m, jnp.float32),
+            gain=jnp.full(m, neg_inf),
+            feature=jnp.zeros(m, jnp.int32),
+            threshold=jnp.zeros(m, jnp.int32),
+            default_left=jnp.zeros(m, bool),
+            is_cat=jnp.zeros(m, bool),
+            left_g=jnp.zeros(m, jnp.float32),
+            left_h=jnp.zeros(m, jnp.float32),
+            left_c=jnp.zeros(m, jnp.float32),
+            created=jnp.zeros(m, bool),
+            expanded=jnp.zeros(m, bool),
+            frontier=jnp.zeros(m, bool),
+            child_l=jnp.zeros(m, jnp.int32),
+            child_r=jnp.zeros(m, jnp.int32),
+            leaf_slot=jnp.zeros(m, jnp.int32),
         )
-
-    def set_at(self, idx, vals):
-        gain, feat, thr, dl, cat, lg, lh, lc = vals
-        return ChildBest(
-            gain=self.gain.at[idx].set(gain, mode="drop"),
-            feature=self.feature.at[idx].set(feat, mode="drop"),
-            threshold=self.threshold.at[idx].set(thr, mode="drop"),
-            default_left=self.default_left.at[idx].set(dl, mode="drop"),
-            is_cat=self.is_cat.at[idx].set(cat, mode="drop"),
-            left_g=self.left_g.at[idx].set(lg, mode="drop"),
-            left_h=self.left_h.at[idx].set(lh, mode="drop"),
-            left_c=self.left_c.at[idx].set(lc, mode="drop"),
-        )
-
-    def get(self, idx):
-        return (self.gain[idx], self.feature[idx], self.threshold[idx],
-                self.default_left[idx], self.is_cat[idx],
-                self.left_g[idx], self.left_h[idx], self.left_c[idx])
 
 
 def _extract_feature_hist(group_hist, sum_g, sum_h, count, fmeta, cfg):
@@ -240,6 +235,10 @@ def _leaf_best_split(hist, sum_g, sum_h, count, depth, feature_mask, fmeta, cfg)
     gains = jnp.where(feature_mask, res.gain, -jnp.inf)
     if cfg.max_depth > 0:
         gains = jnp.where(depth + 1 > cfg.max_depth, -jnp.inf, gains)
+    # clamp to finite: degenerate configs (min_sum_hessian=0, lambda_l2=0)
+    # can yield +inf gains, and the speculative selection needs +inf free
+    # as its force-include sentinel (grow_tree.expand)
+    gains = jnp.minimum(gains, _GAIN_CLAMP)
     best_f = jnp.argmax(gains).astype(jnp.int32)
     pick = lambda arr: arr[best_f]
     vals = (pick(gains), best_f, pick(res.threshold), pick(res.default_left),
@@ -268,62 +267,6 @@ def _leaf_best_split(hist, sum_g, sum_h, count, depth, feature_mask, fmeta, cfg)
 
     return (gmax, bcast(feat_global), bcast(thr), bcast(dl), bcast(cat),
             bcast(lg), bcast(lh), bcast(lc))
-
-
-def _set_leaf_best(state: TreeGrowerState, leaf, vals) -> TreeGrowerState:
-    gain, feat, thr, dl, cat, lg, lh, lc = vals
-    return state._replace(
-        best_gain=state.best_gain.at[leaf].set(gain),
-        best_feature=state.best_feature.at[leaf].set(feat),
-        best_threshold=state.best_threshold.at[leaf].set(thr),
-        best_default_left=state.best_default_left.at[leaf].set(dl),
-        best_is_cat=state.best_is_cat.at[leaf].set(cat),
-        best_left_g=state.best_left_g.at[leaf].set(lg),
-        best_left_h=state.best_left_h.at[leaf].set(lh),
-        best_left_c=state.best_left_c.at[leaf].set(lc),
-    )
-
-
-def _route_leaves(state, binned_T, fmeta, sel, L):
-    """Go-left bits for the rows of the selected leaves, under each leaf's
-    CACHED best split (replaces DataPartition::Split,
-    data_partition.hpp:94-170, and the round-2 per-row gather routing).
-
-    For each selected leaf the split descriptor is a handful of SCALARS
-    (dynamic-indexed from the [L] caches) and the feature's bin column is
-    ONE contiguous dynamic slice of the transposed bin matrix [G, N] —
-    no [N]-indexed gathers anywhere, so nothing routes through the TPU's
-    serialized gather path. Returns state.split_bit updated for rows whose
-    leaf is in `sel`."""
-    split_bit = state.split_bit
-    n = binned_T.shape[1]
-    for k in range(sel.shape[0]):
-        sel_k = sel[k]
-        l = jnp.clip(sel_k, 0, L - 1)
-        feat = state.best_feature[l]
-        grp = fmeta["group"][feat]
-        off = fmeta["offset"][feat]
-        nb = fmeta["num_bin"][feat]
-        dbin = fmeta["default_bin"][feat]
-        missing = fmeta["missing_type"][feat]
-        col = jax.lax.dynamic_slice(
-            binned_T, (grp, 0), (1, n))[0].astype(jnp.int32)
-        # EFB decode (efb.py): inside the feature's bundle slice the group
-        # bin is offset+bin; anywhere else the row sits at the default bin
-        in_slice = (col >= off) & (col < off + nb)
-        decoded = jnp.where(in_slice, col - off, dbin)
-        col = jnp.where(fmeta["is_bundled"][feat], decoded, col)
-        thr = state.best_threshold[l]
-        dl = state.best_default_left[l]
-        cat = state.best_is_cat[l]
-        nan_bin = nb - 1
-        is_missing = (((missing == MISSING_NAN) & (col == nan_bin))
-                      | ((missing == MISSING_ZERO) & (col == dbin)))
-        go_left = jnp.where(cat, col == thr,
-                            jnp.where(is_missing, dl, col <= thr))
-        in_k = state.leaf_id == sel_k
-        split_bit = jnp.where(in_k, go_left, split_bit)
-    return split_bit
 
 
 def _voting_children_best(hists_local, sum_g, sum_h, count, depth,
@@ -383,7 +326,7 @@ def _voting_children_best(hists_local, sum_g, sum_h, count, depth,
     egrp = fmeta["group"][elected]                            # [C, k]
     slices = jax.vmap(lambda h, g: h[g])(hists_local, egrp)   # [C, k, B, 3]
     slices = jax.lax.psum(slices, ax)
-    comm = jnp.float32(c * k_sel * bg * 3 + c * gains_local.shape[1] )
+    comm = jnp.float32(c * k_sel * bg * 3 + c * gains_local.shape[1])
 
     # (5) global scan of elected features with global sums
     eoff = fmeta["offset"][elected]
@@ -412,6 +355,7 @@ def _voting_children_best(hists_local, sum_g, sum_h, count, depth,
         gains = jnp.where(feature_mask[eidx], res.gain, -jnp.inf)
         if cfg.max_depth > 0:
             gains = jnp.where(d + 1 > cfg.max_depth, -jnp.inf, gains)
+        gains = jnp.minimum(gains, _GAIN_CLAMP)
         best = jnp.argmax(gains).astype(jnp.int32)
         pick = lambda a: a[best]
         return (pick(gains), eidx[best], pick(res.threshold),
@@ -421,6 +365,41 @@ def _voting_children_best(hists_local, sum_g, sum_h, count, depth,
 
     vals = jax.vmap(global_scan)(efh, elected, sum_g, sum_h, count, depth)
     return vals, comm
+
+
+# split gains are clamped to this finite ceiling (degenerate configs can
+# produce +inf); the expansion selection then uses +inf as its
+# force-include sentinel, so the commit-blocking node is ALWAYS rank 0 of
+# top_k — which both guarantees progress and keeps the slot-allocation
+# capacity masks monotone in rank (no allocation gaps). Plain float:
+# module import must not touch the XLA backend — multihost workers call
+# jax.distributed.initialize() after importing this package.
+_GAIN_CLAMP = 1e30
+
+
+class _Carry(NamedTuple):
+    leaf_id: jnp.ndarray          # [N] i32: deepest SPEC node per row
+    table: _NodeTable
+    next_free: jnp.ndarray        # scalar i32 allocation pointer
+    num_passes: jnp.ndarray
+    comm_elems: jnp.ndarray
+    # committed-tree output state (slot-indexed), as TreeGrowerState
+    sum_g: jnp.ndarray
+    sum_h: jnp.ndarray
+    count: jnp.ndarray
+    leaf_value: jnp.ndarray
+    leaf_depth: jnp.ndarray
+    leaf_parent: jnp.ndarray
+    node_feature: jnp.ndarray
+    node_threshold: jnp.ndarray
+    node_default_left: jnp.ndarray
+    node_is_cat: jnp.ndarray
+    node_left: jnp.ndarray
+    node_right: jnp.ndarray
+    node_gain: jnp.ndarray
+    node_value: jnp.ndarray
+    node_count: jnp.ndarray
+    num_leaves_used: jnp.ndarray
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",))
@@ -449,6 +428,7 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     L = cfg.num_leaves
     B = cfg.max_bins
     K = max(1, min(cfg.batch_k, L))
+    M = 4 * L + 2 * K + 2
     fmeta = {"num_bin": fmeta_num_bin, "missing_type": fmeta_missing,
              "default_bin": fmeta_default_bin, "is_categorical": fmeta_is_cat,
              "group": fmeta_group, "offset": fmeta_offset,
@@ -488,12 +468,8 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
 
     # transposed bin matrix for the routing step: row g is the contiguous
     # bin column of stored group g (loop-invariant — XLA hoists it out of
-    # the commit loop)
+    # the round loop)
     binned_T = binned.T
-
-    # all rows start in the root; excluded (bagged-out / padded) rows carry
-    # row_weight 0 so they route through splits but contribute nothing
-    leaf_id = jnp.zeros(n, jnp.int32)
 
     # --- root (BeforeTrain: serial_tree_learner.cpp:234-323) ------------
     root_hist = reduce_hist(
@@ -510,10 +486,43 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     if cfg.data_axis is not None:
         root_comm = jnp.float32(3.0 if voting else fl * B * 3)
 
+    if voting:
+        root_vals, comm1 = _voting_children_best(
+            root_hist[None], root_g[None], root_h[None], root_c[None],
+            jnp.zeros(1, jnp.int32), local_fmask, local_fmeta, cfg)
+        root_vals = tuple(v[0] for v in root_vals)
+        root_comm = root_comm + comm1
+    else:
+        root_vals = _leaf_best_split(
+            root_hist, root_g, root_h, root_c, jnp.int32(0), local_fmask,
+            local_fmeta, cfg)
+
+    table = _NodeTable.zeros(M)
+    table = table._replace(
+        parent=table.parent.at[0].set(0),
+        sum_g=table.sum_g.at[0].set(root_g),
+        sum_h=table.sum_h.at[0].set(root_h),
+        count=table.count.at[0].set(root_c),
+        gain=table.gain.at[0].set(root_vals[0]),
+        feature=table.feature.at[0].set(root_vals[1]),
+        threshold=table.threshold.at[0].set(root_vals[2]),
+        default_left=table.default_left.at[0].set(root_vals[3]),
+        is_cat=table.is_cat.at[0].set(root_vals[4]),
+        left_g=table.left_g.at[0].set(root_vals[5]),
+        left_h=table.left_h.at[0].set(root_vals[6]),
+        left_c=table.left_c.at[0].set(root_vals[7]),
+        created=table.created.at[0].set(True),
+        frontier=table.frontier.at[0].set(True),
+        leaf_slot=table.leaf_slot.at[0].set(0),
+    )
+
     neg_inf = jnp.float32(-jnp.inf)
-    state = TreeGrowerState(
-        leaf_id=leaf_id,
-        split_bit=jnp.zeros(n, bool),
+    carry = _Carry(
+        leaf_id=jnp.zeros(n, jnp.int32),
+        table=table,
+        next_free=jnp.int32(1),
+        num_passes=jnp.int32(1),
+        comm_elems=root_comm,
         sum_g=jnp.zeros(L, jnp.float32).at[0].set(root_g),
         sum_h=jnp.zeros(L, jnp.float32).at[0].set(root_h),
         count=jnp.zeros(L, jnp.float32).at[0].set(root_c),
@@ -521,19 +530,6 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             leaf_output(root_g, root_h, cfg.lambda_l1, cfg.lambda_l2)),
         leaf_depth=jnp.zeros(L, jnp.int32),
         leaf_parent=jnp.full(L, -1, jnp.int32),
-        best_gain=jnp.full(L, neg_inf),
-        best_feature=jnp.zeros(L, jnp.int32),
-        best_threshold=jnp.zeros(L, jnp.int32),
-        best_default_left=jnp.zeros(L, bool),
-        best_is_cat=jnp.zeros(L, bool),
-        best_left_g=jnp.zeros(L, jnp.float32),
-        best_left_h=jnp.zeros(L, jnp.float32),
-        best_left_c=jnp.zeros(L, jnp.float32),
-        child_ready=jnp.zeros(L, bool),
-        lbest=ChildBest.zeros(L),
-        rbest=ChildBest.zeros(L),
-        num_passes=jnp.int32(1),
-        comm_elems=root_comm,
         node_feature=jnp.zeros(L - 1, jnp.int32),
         node_threshold=jnp.zeros(L - 1, jnp.int32),
         node_default_left=jnp.zeros(L - 1, bool),
@@ -545,45 +541,87 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         node_count=jnp.zeros(L - 1, jnp.float32),
         num_leaves_used=jnp.int32(1),
     )
-    if voting:
-        root_vals, comm1 = _voting_children_best(
-            root_hist[None], root_g[None], root_h[None], root_c[None],
-            jnp.zeros(1, jnp.int32), local_fmask, local_fmeta, cfg)
-        state = state._replace(comm_elems=state.comm_elems + comm1)
-        state = _set_leaf_best(state, 0, tuple(v[0] for v in root_vals))
-    else:
-        state = _set_leaf_best(state, 0, _leaf_best_split(
-            root_hist, root_g, root_h, root_c, jnp.int32(0), local_fmask,
-            local_fmeta, cfg))
 
-    def prefetch(state: TreeGrowerState) -> TreeGrowerState:
-        """One batched data pass: route the rows of the top-K pending
-        leaves (positive cached gain, children not ready) under their
-        cached splits, build BOTH children's histograms for all K leaves
-        in one contraction, scan their best splits, cache them
-        parent-indexed. Exactly the work the sequential grower would do at
-        each of those leaves' commits — done K at a time."""
-        pending = (state.best_gain > 0.0) & ~state.child_ready
-        cand = jnp.where(pending, state.best_gain, -jnp.inf)
-        top_gain, top_idx = jax.lax.top_k(cand, K)
-        sel = jnp.where(jnp.isfinite(top_gain), top_idx, jnp.int32(L))  # L = drop
+    def expand(carry: _Carry) -> _Carry:
+        """One speculative expansion pass: select up to K unexpanded nodes
+        (commit-blocking argmax force-included), route+relabel their rows
+        under their cached splits, build both children's histograms in one
+        contraction, scan the children's best splits into the table."""
+        t = carry.table
+        eligible = t.created & ~t.expanded & (t.gain > 0.0)
+        f_gain = jnp.where(t.frontier, t.gain, neg_inf)
+        f_arg = jnp.argmax(f_gain).astype(jnp.int32)
+        score = jnp.where(eligible, t.gain, neg_inf)
+        score = score.at[f_arg].set(
+            jnp.where(eligible[f_arg], jnp.inf, score[f_arg]))
+        top_gain, sel = jax.lax.top_k(score, K)
+        valid = top_gain > neg_inf                           # [K]
 
-        # per-row go-left bits under the selected leaves' cached splits
-        # (full/global feature space — routing never shards features)
-        split_bit = _route_leaves(state, binned_T, fmeta, sel, L)
+        # allocate child slots (rank-compacted so padding slots don't
+        # leak table space). Capacity invariant: every future commit may
+        # need one forced expansion of the frontier argmax (2 slots), so
+        # SPECULATIVE allocations must leave 2*(L - num_leaves_used)
+        # slots in reserve — the forced expansion itself may dip into
+        # the reserve. This keeps the commit chain unblockable and the
+        # bit-identical-to-sequential guarantee unconditional, for any
+        # table fill pattern.
+        rank = jnp.cumsum(valid.astype(jnp.int32)) - valid.astype(jnp.int32)
+        cl = carry.next_free + 2 * rank
+        cr = cl + 1
+        reserve = 2 * (L - carry.num_leaves_used)
+        is_forced = eligible[f_arg] & (sel == f_arg)
+        valid = valid & jnp.where(is_forced, cr < M, cr + reserve < M)
+        cl_eff = jnp.where(valid, cl, M)
+        cr_eff = jnp.where(valid, cr, M)
+        sel_eff = jnp.where(valid, sel, M)
+        next_free = carry.next_free + 2 * jnp.sum(valid.astype(jnp.int32))
 
-        hists = reduce_hist(hist_ops.batched_children_histogram(
-            local_binned, w3, state.leaf_id, split_bit, sel, B, cfg.chunk,
+        # route + relabel the selected nodes' rows (replaces
+        # DataPartition::Split, data_partition.hpp:94-170): each split
+        # descriptor is a handful of SCALARS and the feature's bin column
+        # is ONE contiguous dynamic slice of the transposed bin matrix —
+        # no [N]-indexed gathers anywhere
+        leaf_id = carry.leaf_id
+        for k in range(K):
+            m_k = jnp.clip(sel[k], 0, M - 1)
+            feat = t.feature[m_k]
+            grp = fmeta["group"][feat]
+            off = fmeta["offset"][feat]
+            nb = fmeta["num_bin"][feat]
+            dbin = fmeta["default_bin"][feat]
+            missing = fmeta["missing_type"][feat]
+            col = jax.lax.dynamic_slice(
+                binned_T, (grp, 0), (1, n))[0].astype(jnp.int32)
+            # EFB decode (efb.py): inside the feature's bundle slice the
+            # group bin is offset+bin; anywhere else the row sits at the
+            # default bin
+            in_slice = (col >= off) & (col < off + nb)
+            decoded = jnp.where(in_slice, col - off, dbin)
+            col = jnp.where(fmeta["is_bundled"][feat], decoded, col)
+            thr = t.threshold[m_k]
+            dl = t.default_left[m_k]
+            cat = t.is_cat[m_k]
+            nan_bin = nb - 1
+            is_missing = (((missing == MISSING_NAN) & (col == nan_bin))
+                          | ((missing == MISSING_ZERO) & (col == dbin)))
+            go_left = jnp.where(cat, col == thr,
+                                jnp.where(is_missing, dl, col <= thr))
+            in_k = valid[k] & (leaf_id == sel[k])
+            leaf_id = jnp.where(in_k, jnp.where(go_left, cl[k], cr[k]),
+                                leaf_id)
+
+        ids2k = jnp.concatenate([jnp.where(valid, cl, -1),
+                                 jnp.where(valid, cr, -1)])
+        hists = reduce_hist(hist_ops.batched_leaves_histogram(
+            local_binned, w3, leaf_id, ids2k, B, cfg.chunk,
             bf16=cfg.hist_bf16))                             # [2K, fl, B, 3]
 
-        # children aggregates from the cached split stats
-        pg = state.sum_g[jnp.clip(sel, 0, L - 1)]
-        ph = state.sum_h[jnp.clip(sel, 0, L - 1)]
-        pc = state.count[jnp.clip(sel, 0, L - 1)]
-        lg = state.best_left_g[jnp.clip(sel, 0, L - 1)]
-        lh = state.best_left_h[jnp.clip(sel, 0, L - 1)]
-        lcc = state.best_left_c[jnp.clip(sel, 0, L - 1)]
-        cdepth = state.leaf_depth[jnp.clip(sel, 0, L - 1)] + 1
+        # children aggregates from the parents' cached split stats
+        sel_c = jnp.clip(sel, 0, M - 1)
+        pg, ph, pc = t.sum_g[sel_c], t.sum_h[sel_c], t.count[sel_c]
+        lg, lh = t.left_g[sel_c], t.left_h[sel_c]
+        lcc = t.left_c[sel_c]
+        cdepth = t.depth[sel_c] + 1
         all_g = jnp.concatenate([lg, pg - lg])
         all_h = jnp.concatenate([lh, ph - lh])
         all_c = jnp.concatenate([lcc, pc - lcc])
@@ -601,129 +639,163 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                 lambda h, g, hh, c, d: _leaf_best_split(
                     h, g, hh, c, d, local_fmask, local_fmeta, cfg))
             vals2 = split_fn(hists, all_g, all_h, all_c, all_d)
-        lvals = tuple(v[:K] for v in vals2)
-        rvals = tuple(v[K:] for v in vals2)
+        gain2, feat2, thr2, dl2, cat2, lg2, lh2, lc2 = vals2
 
-        return state._replace(
-            split_bit=split_bit,
-            lbest=state.lbest.set_at(sel, lvals),
-            rbest=state.rbest.set_at(sel, rvals),
-            child_ready=state.child_ready.at[sel].set(True, mode="drop"),
-            num_passes=state.num_passes + 1,
-            comm_elems=state.comm_elems + comm,
+        idx = jnp.concatenate([cl_eff, cr_eff])              # [2K], M = drop
+        par2 = jnp.concatenate([sel_eff, sel_eff])
+        t = t._replace(
+            parent=t.parent.at[idx].set(par2, mode="drop"),
+            depth=t.depth.at[idx].set(all_d, mode="drop"),
+            sum_g=t.sum_g.at[idx].set(all_g, mode="drop"),
+            sum_h=t.sum_h.at[idx].set(all_h, mode="drop"),
+            count=t.count.at[idx].set(all_c, mode="drop"),
+            gain=t.gain.at[idx].set(gain2, mode="drop"),
+            feature=t.feature.at[idx].set(feat2, mode="drop"),
+            threshold=t.threshold.at[idx].set(thr2, mode="drop"),
+            default_left=t.default_left.at[idx].set(dl2, mode="drop"),
+            is_cat=t.is_cat.at[idx].set(cat2, mode="drop"),
+            left_g=t.left_g.at[idx].set(lg2, mode="drop"),
+            left_h=t.left_h.at[idx].set(lh2, mode="drop"),
+            left_c=t.left_c.at[idx].set(lc2, mode="drop"),
+            created=t.created.at[idx].set(True, mode="drop"),
+            expanded=t.expanded.at[sel_eff].set(True, mode="drop"),
+            child_l=t.child_l.at[sel_eff].set(cl, mode="drop"),
+            child_r=t.child_r.at[sel_eff].set(cr, mode="drop"),
         )
+        return carry._replace(
+            leaf_id=leaf_id, table=t, next_free=next_free,
+            num_passes=carry.num_passes + 1,
+            comm_elems=carry.comm_elems + comm)
 
-    # --- split loop (Train: serial_tree_learner.cpp:152-205) ------------
-    # Round-structured: ONE prefetch + up to C small-state commits + ONE
-    # batched row update per round. The commit sequence is the exact
-    # best-first argmax order (a commit stalls as soon as the argmax leaf
-    # is a not-yet-prefetched child), so trees are identical to a
-    # commit-per-iteration loop — but the [N]-sized arrays cross a loop
-    # boundary only once per ROUND (~passes, not ~leaves): profiled on
-    # hardware, per-iteration cond copies of leaf_id/split_bit rivaled
-    # the histogram work itself.
-    C = max(2, min(K, 16))  # max commits applied per round
+    # --- commit (Train: serial_tree_learner.cpp:152-205) ----------------
+    # strict best-first: pop the frontier argmax, write the tree node,
+    # promote the (speculatively created) children to the frontier.
+    # Touches only [M]/[L]-sized state — zero data passes.
+    C = max(4, 2 * K)  # commits drained per round
 
-    def commit_one(state: TreeGrowerState):
-        """One best-first commit touching ONLY [L]/node-sized state.
-        Returns (state, committed_leaf, new_leaf) — leaf L marks 'none'."""
-        l = jnp.argmax(state.best_gain).astype(jnp.int32)
-        new_leaf = state.num_leaves_used
-        node = state.num_leaves_used - 1
-        feat = state.best_feature[l]
-        thr = state.best_threshold[l]
-        dl = state.best_default_left[l]
-        cat = state.best_is_cat[l]
-        lg, lh, lc = state.best_left_g[l], state.best_left_h[l], state.best_left_c[l]
-        pg, ph, pc = state.sum_g[l], state.sum_h[l], state.count[l]
+    def commit_one(carry: _Carry):
+        t = carry.table
+        f_gain = jnp.where(t.frontier, t.gain, neg_inf)
+        l = jnp.argmax(f_gain).astype(jnp.int32)
+        feat = t.feature[l]
+        thr = t.threshold[l]
+        dl = t.default_left[l]
+        cat = t.is_cat[l]
+        lg, lh, lc = t.left_g[l], t.left_h[l], t.left_c[l]
+        pg, ph, pc = t.sum_g[l], t.sum_h[l], t.count[l]
         rg, rh, rc = pg - lg, ph - lh, pc - lc
+        slot_l = t.leaf_slot[l]
+        new_slot = carry.num_leaves_used
+        node = carry.num_leaves_used - 1
 
         # tree bookkeeping (Tree::Split, tree.cpp:50-69)
-        parent_node = state.leaf_parent[l]
+        parent_node = carry.leaf_parent[slot_l]
         has_parent = parent_node >= 0
         pn = jnp.maximum(parent_node, 0)
-        fix_left = state.node_left[pn] == ~l
-        node_left = state.node_left.at[pn].set(
-            jnp.where(has_parent & fix_left, node, state.node_left[pn]))
-        node_right = state.node_right.at[pn].set(
-            jnp.where(has_parent & ~fix_left, node, state.node_right[pn]))
-        node_left = node_left.at[node].set(~l)
-        node_right = node_right.at[node].set(~new_leaf)
+        fix_left = carry.node_left[pn] == ~slot_l
+        node_left = carry.node_left.at[pn].set(
+            jnp.where(has_parent & fix_left, node, carry.node_left[pn]))
+        node_right = carry.node_right.at[pn].set(
+            jnp.where(has_parent & ~fix_left, node, carry.node_right[pn]))
+        node_left = node_left.at[node].set(~slot_l)
+        node_right = node_right.at[node].set(~new_slot)
 
-        depth_l = state.leaf_depth[l]
+        depth_l = carry.leaf_depth[slot_l]
         lv = leaf_output(lg, lh, cfg.lambda_l1, cfg.lambda_l2)
         rv = leaf_output(rg, rh, cfg.lambda_l1, cfg.lambda_l2)
 
-        state = state._replace(
-            sum_g=state.sum_g.at[l].set(lg).at[new_leaf].set(rg),
-            sum_h=state.sum_h.at[l].set(lh).at[new_leaf].set(rh),
-            count=state.count.at[l].set(lc).at[new_leaf].set(rc),
-            leaf_value=state.leaf_value.at[l].set(lv).at[new_leaf].set(rv),
-            leaf_depth=state.leaf_depth.at[l].set(depth_l + 1)
-                                       .at[new_leaf].set(depth_l + 1),
-            leaf_parent=state.leaf_parent.at[l].set(node)
-                                         .at[new_leaf].set(node),
-            child_ready=state.child_ready.at[l].set(False)
-                                         .at[new_leaf].set(False),
-            node_feature=state.node_feature.at[node].set(feat),
-            node_threshold=state.node_threshold.at[node].set(thr),
-            node_default_left=state.node_default_left.at[node].set(dl),
-            node_is_cat=state.node_is_cat.at[node].set(cat),
+        cl, cr = t.child_l[l], t.child_r[l]
+        t = t._replace(
+            frontier=t.frontier.at[l].set(False)
+                               .at[cl].set(True).at[cr].set(True),
+            leaf_slot=t.leaf_slot.at[cl].set(slot_l).at[cr].set(new_slot),
+        )
+        return carry._replace(
+            table=t,
+            sum_g=carry.sum_g.at[slot_l].set(lg).at[new_slot].set(rg),
+            sum_h=carry.sum_h.at[slot_l].set(lh).at[new_slot].set(rh),
+            count=carry.count.at[slot_l].set(lc).at[new_slot].set(rc),
+            leaf_value=carry.leaf_value.at[slot_l].set(lv)
+                                       .at[new_slot].set(rv),
+            leaf_depth=carry.leaf_depth.at[slot_l].set(depth_l + 1)
+                                       .at[new_slot].set(depth_l + 1),
+            leaf_parent=carry.leaf_parent.at[slot_l].set(node)
+                                         .at[new_slot].set(node),
+            node_feature=carry.node_feature.at[node].set(feat),
+            node_threshold=carry.node_threshold.at[node].set(thr),
+            node_default_left=carry.node_default_left.at[node].set(dl),
+            node_is_cat=carry.node_is_cat.at[node].set(cat),
             node_left=node_left,
             node_right=node_right,
-            node_gain=state.node_gain.at[node].set(state.best_gain[l]),
-            node_value=state.node_value.at[node].set(
+            node_gain=carry.node_gain.at[node].set(t.gain[l]),
+            node_value=carry.node_value.at[node].set(
                 leaf_output(pg, ph, cfg.lambda_l1, cfg.lambda_l2)),
-            node_count=state.node_count.at[node].set(pc),
-            num_leaves_used=state.num_leaves_used + 1,
+            node_count=carry.node_count.at[node].set(pc),
+            num_leaves_used=carry.num_leaves_used + 1,
         )
-        # install the prefetched children best splits
-        state = _set_leaf_best(state, l, state.lbest.get(l))
-        state = _set_leaf_best(state, new_leaf, state.rbest.get(l))
-        return state, l, new_leaf
 
-    def round_body(state: TreeGrowerState) -> TreeGrowerState:
-        # prefetch unconditionally: the argmax leaf is un-prefetched at
-        # the start of almost every round (the inner loop below drains
-        # ready leaves), and skipping the lax.cond keeps the [N]-sized
-        # state flowing straight through the while-loop body. top_k
-        # returns only pending leaves, so a rare redundant prefetch
-        # re-selects nothing (sel = all-L padding)
-        state = prefetch(state)
+    def round_body(carry: _Carry) -> _Carry:
+        carry = expand(carry)
 
         def inner(j, carry):
-            state, rec_l, rec_n = carry
-            l = jnp.argmax(state.best_gain).astype(jnp.int32)
-            can = ((state.best_gain[l] > 0.0) & state.child_ready[l]
-                   & (state.num_leaves_used < L))
+            t = carry.table
+            f_gain = jnp.where(t.frontier, t.gain, neg_inf)
+            l = jnp.argmax(f_gain).astype(jnp.int32)
+            can = ((f_gain[l] > 0.0) & t.expanded[l]
+                   & (carry.num_leaves_used < L))
+            return jax.lax.cond(can, commit_one, lambda c: c, carry)
 
-            def do(carry):
-                state, rec_l, rec_n = carry
-                state, cl, nl = commit_one(state)
-                return (state, rec_l.at[j].set(cl), rec_n.at[j].set(nl))
+        return jax.lax.fori_loop(0, C, inner, carry)
 
-            return jax.lax.cond(can, do, lambda c: c,
-                                (state, rec_l, rec_n))
+    def round_cond(carry: _Carry):
+        t = carry.table
+        f_gain = jnp.where(t.frontier, t.gain, neg_inf)
+        growing = (carry.num_leaves_used < L) & (jnp.max(f_gain) > 0.0)
+        # safety net only: the reservation rule in expand() guarantees the
+        # blocking argmax always has room, so this guard cannot trip
+        f_arg = jnp.argmax(f_gain)
+        progress = t.expanded[f_arg] | (carry.next_free + 1 < M)
+        return growing & progress
 
-        rec_l = jnp.full(C, L, jnp.int32)   # L = empty slot
-        rec_n = jnp.zeros(C, jnp.int32)
-        state, rec_l, rec_n = jax.lax.fori_loop(
-            0, C, inner, (state, rec_l, rec_n))
+    carry = jax.lax.while_loop(round_cond, round_body, carry)
 
-        # batched row routing for every commit of this round: committed
-        # leaves are distinct and none of their children can commit in
-        # the same round, so the updates are order-independent
-        leaf_id = state.leaf_id
-        for j in range(C):
-            mov = (leaf_id == rec_l[j]) & ~state.split_bit
-            leaf_id = jnp.where(mov, rec_n[j], leaf_id)
-        return state._replace(leaf_id=leaf_id)
+    # --- map rows to committed leaf slots -------------------------------
+    # rows are labeled with UNEXPANDED spec node ids; each maps to its
+    # nearest frontier ancestor's leaf slot. Saturating pointer-doubling
+    # (ancestors stop at resolved nodes so a jump can never skip the
+    # frontier into the committed region); spec depth is bounded by the
+    # number of allocations (M/2), so ceil(log2(M))+1 hops always resolve.
+    t = carry.table
+    slot_map = jnp.where(t.frontier, t.leaf_slot, -1)
+    anc = jnp.where(t.frontier, jnp.arange(M, dtype=jnp.int32), t.parent)
+    hops = int(M).bit_length() + 1
 
-    def round_cond(state: TreeGrowerState):
-        return (state.num_leaves_used < L) & (jnp.max(state.best_gain) > 0.0)
+    def hop(_, sm_anc):
+        sm, a = sm_anc
+        sm = jnp.where(sm >= 0, sm, sm[a])
+        a = jnp.where(sm >= 0, jnp.arange(M, dtype=jnp.int32),
+                      jnp.where(sm[a] >= 0, a, a[a]))
+        return sm, a
 
-    state = jax.lax.while_loop(round_cond, round_body, state)
-    return state
+    slot_map, _ = jax.lax.fori_loop(0, hops, hop, (slot_map, anc))
+    slot_map = jnp.clip(slot_map, 0, L - 1)
+    leaf_slot_of_row = slot_map[jnp.clip(carry.leaf_id, 0, M - 1)]
+
+    return TreeGrowerState(
+        leaf_id=leaf_slot_of_row,
+        sum_g=carry.sum_g, sum_h=carry.sum_h, count=carry.count,
+        leaf_value=carry.leaf_value, leaf_depth=carry.leaf_depth,
+        leaf_parent=carry.leaf_parent,
+        num_passes=carry.num_passes, comm_elems=carry.comm_elems,
+        node_feature=carry.node_feature,
+        node_threshold=carry.node_threshold,
+        node_default_left=carry.node_default_left,
+        node_is_cat=carry.node_is_cat,
+        node_left=carry.node_left, node_right=carry.node_right,
+        node_gain=carry.node_gain, node_value=carry.node_value,
+        node_count=carry.node_count,
+        num_leaves_used=carry.num_leaves_used,
+    )
 
 
 FMETA_KEYS = ("num_bin", "missing_type", "default_bin", "is_categorical",

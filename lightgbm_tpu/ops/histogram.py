@@ -25,13 +25,14 @@ Two performance levers over the naive contraction:
   2 extra grad/hess channels per child slot. grad/hess recover ~16
   mantissa bits — within f32 round-off of the true sum — at bf16 MXU
   rates.
-- `batched_children_histogram` builds BOTH children's histograms of K
-  splitting leaves in ONE pass by widening the contraction's output
-  dimension from 3 to 2K*3 (+2K*2 lo-correction) channels — the MXU is
-  utilization-bound on that dimension, so everything fits one 128-lane
-  output tile for K <= 12. This is what makes priority-batched growth
-  (learner/grow.py) O(N * passes/K) instead of O(N * leaves), with no
-  parent histogram state at all.
+- `batched_leaves_histogram` — the in-training kernel — builds the
+  histograms of 2K child nodes of the speculative grower
+  (learner/grow.py) in ONE pass by widening the contraction's output
+  dimension from 3 to 2K*3 (+2K*2 lo-correction) channels. The MXU's
+  output tile is 128 lanes whether 5 or 128 of them are live, so the
+  grower sizes 2K*(3+2) to fill the tile (batch_k=12) — extra slots
+  are free, and the per-pass cost sits at ~70% of the bf16 matmul
+  roofline (profiles/README.md).
 """
 from __future__ import annotations
 
@@ -111,71 +112,62 @@ def leaf_histogram(binned: jnp.ndarray, weights: jnp.ndarray,
 
 @functools.partial(jax.jit,
                    static_argnames=("num_bins", "chunk", "bf16"))
-def batched_children_histogram(binned: jnp.ndarray, weights: jnp.ndarray,
-                               leaf_id: jnp.ndarray, split_bit: jnp.ndarray,
-                               leaves: jnp.ndarray, num_bins: int,
-                               chunk: int = 16384,
-                               bf16: bool = True) -> jnp.ndarray:
-    """BOTH children's histograms of K splitting leaves in one data pass.
+def batched_leaves_histogram(binned: jnp.ndarray, weights: jnp.ndarray,
+                             leaf_id: jnp.ndarray, ids: jnp.ndarray,
+                             num_bins: int, chunk: int = 16384,
+                             bf16: bool = True) -> jnp.ndarray:
+    """Histograms of C arbitrary leaf-label ids in one data pass.
 
-    split_bit[r] is the go-left decision of row r under ITS OWN leaf's
-    cached best split (computed by the grower's routing step). Output
-    [2K, F, B, 3]: slot k is the LEFT child of leaves[k], slot K+k the
-    RIGHT child. The contraction's output dim widens from 3 to 2K*3
-    (+2K*2 bf16 lo-correction) channels — the MXU is utilization-bound
-    there, and everything fits ONE 128-lane output tile for K <= 12 —
-    so both children of K leaves cost one pass, replacing the
-    reference's smaller-child pass + parent-minus subtraction
-    (serial_tree_learner.cpp:349-363, 482-487) without keeping any
-    parent histogram state at all.
+    The speculative grower (learner/grow.py) relabels rows to child node
+    ids BEFORE building their histograms, so membership is a direct
+    `leaf_id == ids[k]` compare — no split bit. Returns [C, F, B, 3].
+
+    Two deliberate design choices, both profiled on hardware:
+    - rows are walked with `lax.dynamic_slice` chunks instead of an
+      upfront reshape to [n_chunks, chunk, F]: the reshape forced XLA to
+      materialize two layout copies of the whole bin matrix per pass
+      (~0.15 ms/pass at 0.5M rows — `profiles/README.md` round 2);
+    - the contraction's MXU output tile is 128 lanes no matter how few
+      channels are live, so C is sized by the caller to fill it
+      (C*(3 hi + 2 lo) <= 128, i.e. C <= 25) — extra slots are free.
     """
     n, f = binned.shape
     if n % chunk != 0:
         raise ValueError(f"rows ({n}) must be padded to a multiple of chunk ({chunk})")
-    k = leaves.shape[0]
+    c_ids = ids.shape[0]
     n_chunks = n // chunk
-    binned_c = binned.reshape(n_chunks, chunk, f)
-    w_c = weights.reshape(n_chunks, chunk, 3)
-    lid_c = leaf_id.reshape(n_chunks, chunk)
-    bit_c = split_bit.reshape(n_chunks, chunk)
 
-    def one(b_chunk, w_chunk, lid_chunk, bit_chunk):
-        member = lid_chunk[:, None] == leaves[None, :]        # [C, K]
-        m2k = jnp.concatenate(
-            [member & bit_chunk[:, None], member & ~bit_chunk[:, None]],
-            axis=1)                                           # [C, 2K]
+    def one(c):
+        b_chunk = jax.lax.dynamic_slice(binned, (c * chunk, 0), (chunk, f))
+        w_chunk = jax.lax.dynamic_slice(weights, (c * chunk, 0), (chunk, 3))
+        lid = jax.lax.dynamic_slice(leaf_id, (c * chunk,), (chunk,))
+        member = lid[:, None] == ids[None, :]                  # [C, K]
         oh = _onehot(b_chunk, num_bins)
         if not bf16:
-            u = (m2k[:, :, None].astype(jnp.float32)
-                 * w_chunk[:, None, :]).reshape(chunk, 2 * k * 3)
-            return _contract(oh, u, False)                    # [F,B,2K*3]
-        # bf16 hi+lo in ONE contraction: the count channel's values are
-        # 0/1 (bf16-exact, lo == 0), so the lo correction needs only the
-        # grad/hess channels — 2K*3 hi + 2K*2 lo channels ride a single
-        # MXU pass (<= 128 output lanes for K <= 12) instead of two
-        # full-width passes
-        hi, lo = _hi_lo(w_chunk)                              # [C, 3]
-        m2kb = m2k[:, :, None].astype(jnp.bfloat16)
-        u_hi = (m2kb * hi[:, None, :]).reshape(chunk, 2 * k * 3)
-        u_lo = (m2kb[:, :, 0:2] * lo[:, None, 0:2]
-                ).reshape(chunk, 2 * k * 2)
+            u = (member[:, :, None].astype(jnp.float32)
+                 * w_chunk[:, None, :]).reshape(chunk, c_ids * 3)
+            return _contract(oh, u, False)
+        hi, lo = _hi_lo(w_chunk)
+        mb = member[:, :, None].astype(jnp.bfloat16)
+        u_hi = (mb * hi[:, None, :]).reshape(chunk, c_ids * 3)
+        u_lo = (mb[:, :, 0:2] * lo[:, None, 0:2]).reshape(chunk, c_ids * 2)
         u = jnp.concatenate([u_hi, u_lo], axis=1)
         both = jnp.einsum("cfb,cs->fbs", oh.astype(jnp.bfloat16), u,
                           preferred_element_type=jnp.float32)
-        main = both[:, :, :2 * k * 3].reshape(f, num_bins, 2 * k, 3)
-        corr = both[:, :, 2 * k * 3:].reshape(f, num_bins, 2 * k, 2)
+        main = both[:, :, :c_ids * 3].reshape(f, num_bins, c_ids, 3)
+        corr = both[:, :, c_ids * 3:].reshape(f, num_bins, c_ids, 2)
         return (main.at[:, :, :, 0:2].add(corr)
-                .reshape(f, num_bins, 2 * k * 3))
+                .reshape(f, num_bins, c_ids * 3))
 
     if n_chunks == 1:
-        hist = one(binned_c[0], w_c[0], lid_c[0], bit_c[0])
+        hist = one(jnp.int32(0))
     else:
-        def body(acc, xs):
-            return acc + one(*xs), None
+        def body(c, acc):
+            return acc + one(c)
 
-        init = jnp.zeros((f, num_bins, 2 * k * 3), dtype=jnp.float32)
-        hist, _ = jax.lax.scan(body, init, (binned_c, w_c, lid_c, bit_c))
-    return hist.reshape(f, num_bins, 2 * k, 3).transpose(2, 0, 1, 3)
+        init = jnp.zeros((f, num_bins, c_ids * 3), dtype=jnp.float32)
+        hist = jax.lax.fori_loop(0, n_chunks, body, init)
+    return hist.reshape(f, num_bins, c_ids, 3).transpose(2, 0, 1, 3)
 
 
 def leaf_weights(grad: jnp.ndarray, hess: jnp.ndarray, leaf_id: jnp.ndarray,

@@ -108,7 +108,6 @@ class DataParallelGrower:
         ax = self.axis
         fields = {name: P() for name in TreeGrowerState._fields}
         fields["leaf_id"] = P(ax)
-        fields["split_bit"] = P(ax)
         return TreeGrowerState(**fields)
 
 

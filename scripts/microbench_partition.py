@@ -1,0 +1,155 @@
+"""Microbenchmarks for the partitioned-grower primitives on real TPU.
+
+Validates the round-3 redesign before committing to it:
+  1. row-gather of the transposed bin matrix  binned_T[:, src]
+  2. i32 scatter (permutation inversion)      zeros.at[dest].set(iota)
+  3. chunk-walk while_loop einsum vs lax.scan (per-step overhead)
+  4. production batched_leaves_histogram cost at the same shapes
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+N = 2 * 1024 * 1024
+G = 28
+B = 64
+CH = 8192
+K = 12
+S = 2 * K * 5  # 2K*(3 hi + 2 lo)
+
+rng = np.random.default_rng(0)
+binned_T = jnp.asarray(rng.integers(0, B, size=(G, N), dtype=np.uint8))
+w3 = jnp.asarray(rng.standard_normal((N, 3)).astype(np.float32))
+perm = jnp.asarray(rng.permutation(N).astype(np.int32))
+
+
+def timeit(name, fn, *args, reps=20):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / reps
+    print(f"{name:45s} {dt*1e3:9.3f} ms")
+    return dt
+
+
+@jax.jit
+def gather_T(bt, src):
+    return bt[:, src]
+
+
+@jax.jit
+def gather_rows(bt, src):
+    # row-major gather on the [N, G] layout instead
+    return bt.T[src]
+
+
+@jax.jit
+def gather_w3(w, src):
+    return w[src]
+
+
+@jax.jit
+def scatter_inv(dest):
+    return jnp.zeros(N, jnp.int32).at[dest].set(
+        jnp.arange(N, dtype=jnp.int32))
+
+
+@jax.jit
+def two_cumsums(bits):
+    a = jnp.cumsum(bits.astype(jnp.int32))
+    b = jnp.cumsum((~bits).astype(jnp.int32))
+    return a, b
+
+
+def chunk_step(bt, w, c):
+    blk = jax.lax.dynamic_slice(bt, (0, c * CH), (G, CH))        # [G, CH]
+    oh = (blk[:, :, None] ==
+          jnp.arange(B, dtype=jnp.uint8)[None, None, :])          # [G,CH,B]
+    u = jax.lax.dynamic_slice(w, (c * CH, 0), (CH, 3))
+    u = jnp.tile(u, (1, S // 3 + 1))[:, :S].astype(jnp.bfloat16)
+    return jnp.einsum("gcb,cs->gbs", oh.astype(jnp.bfloat16), u,
+                      preferred_element_type=jnp.float32)
+
+
+@jax.jit
+def walk_while(bt, w, n_chunks):
+    def cond(carry):
+        c, _ = carry
+        return c < n_chunks
+
+    def body(carry):
+        c, acc = carry
+        return c + 1, acc + chunk_step(bt, w, c)
+
+    _, acc = jax.lax.while_loop(
+        cond, body, (jnp.int32(0), jnp.zeros((G, B, S), jnp.float32)))
+    return acc
+
+
+@jax.jit
+def walk_scan(bt, w):
+    def body(acc, c):
+        return acc + chunk_step(bt, w, c), None
+
+    acc, _ = jax.lax.scan(body, jnp.zeros((G, B, S), jnp.float32),
+                          jnp.arange(N // CH, dtype=jnp.int32))
+    return acc
+
+
+@jax.jit
+def update_slice_bits(bits, c, val):
+    return jax.lax.dynamic_update_slice(bits, val, (c * CH,))
+
+
+def main():
+    print(f"devices: {jax.devices()}")
+    print(f"N={N} G={G} B={B} CH={CH} S={S}")
+    dt_g = timeit("gather binned_T[:, src]  (56MB u8)", gather_T, binned_T, perm)
+    print(f"    -> {2 * N * G / dt_g / 1e9:.1f} GB/s effective")
+    dt_gr = timeit("gather rows binned[src]  (row-major)", gather_rows,
+                   binned_T, perm)
+    print(f"    -> {2 * N * G / dt_gr / 1e9:.1f} GB/s effective")
+    timeit("gather w3[src]           (24MB f32)", gather_w3, w3, perm)
+    timeit("scatter inv (i32[N])", scatter_inv, perm)
+    bits = perm % 2 == 0
+    timeit("2x cumsum over N", two_cumsums, bits)
+    val = jnp.ones(CH, bool)
+    timeit("dynamic_update_slice [N] bool", update_slice_bits, bits,
+           jnp.int32(5), val)
+
+    full = N // CH
+    dt_full = timeit(f"while-walk {full} chunks (full N)", walk_while,
+                     binned_T, w3, jnp.int32(full), reps=5)
+    print(f"    -> {N * G * B * S * 2 / dt_full / 1e12:.1f} TFLOP/s")
+    dt_scan = timeit(f"scan-walk  {full} chunks (full N)", walk_scan,
+                     binned_T, w3, reps=5)
+    print(f"    -> {N * G * B * S * 2 / dt_scan / 1e12:.1f} TFLOP/s")
+    for frac in (2, 8, 32):
+        nc = full // frac
+        dt = timeit(f"while-walk {nc} chunks (N/{frac})", walk_while,
+                    binned_T, w3, jnp.int32(nc), reps=10)
+        print(f"    -> per-chunk {dt/nc*1e6:.1f} us")
+
+    # current kernel for comparison
+    from lightgbm_tpu.ops import histogram as hist_ops
+    leaf_id = jnp.zeros(N, jnp.int32)
+    ids = jnp.arange(24, dtype=jnp.int32)
+    binned = binned_T.T.copy()
+
+    @jax.jit
+    def current(b, w, lid, lv):
+        return hist_ops.batched_leaves_histogram(
+            b, w, lid, lv, B, 16384, bf16=True)
+
+    dt_cur = timeit("production batched_leaves_histogram C=24", current,
+                    binned, w3, leaf_id, ids, reps=5)
+    print(f"    -> {N * G * B * 120 * 2 / dt_cur / 1e12:.1f} TFLOP/s")
+
+
+if __name__ == "__main__":
+    main()

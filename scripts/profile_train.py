@@ -147,7 +147,7 @@ THROUGH the jitted tree grower with fresh inputs per repetition — the
 runtime content-caches identical dispatches and isolated microbenchmarks
 compile to different buffer placements, so naive op timings mislead.
 
-## Histogram passes (batched_children_histogram, in-training)
+## Histogram passes (batched_leaves_histogram, in-training)
 
 - {N} rows x {F} features x {B} bins, {2 * K} child histograms/pass
 - **{tree_s:.3f} s per 255-leaf tree**, {passes / 3:.0f} data passes/tree

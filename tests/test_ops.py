@@ -41,29 +41,26 @@ def test_histogram_matches_numpy():
     np.testing.assert_array_equal(hist16[:, :, 2], ref[:, :, 2])
 
 
-def test_batched_children_histogram_matches_per_leaf():
-    from lightgbm_tpu.ops.histogram import batched_children_histogram
+def test_batched_leaves_histogram_matches_per_leaf():
+    from lightgbm_tpu.ops.histogram import batched_leaves_histogram
     rng = np.random.RandomState(3)
-    n, f, B, K = 512, 4, 16, 4
+    n, f, B, C = 512, 4, 16, 6
     binned = rng.randint(0, B, size=(n, f)).astype(np.uint8)
     g = rng.randn(n).astype(np.float32)
     h = rng.rand(n).astype(np.float32)
     w = np.stack([g, h, np.ones(n, np.float32)], axis=1)
     leaf_id = rng.randint(0, 6, size=n).astype(np.int32)
-    split_bit = rng.rand(n) < 0.7  # go-left decision per row
-    leaves = np.asarray([0, 2, 5, 99], np.int32)  # 99 = padding (no rows)
-    out = np.asarray(batched_children_histogram(
+    # -1 = the padding id the speculative grower uses for invalid slots
+    ids = np.asarray([0, 2, 5, 99, -1, 3], np.int32)
+    out = np.asarray(batched_leaves_histogram(
         jnp.asarray(binned), jnp.asarray(w), jnp.asarray(leaf_id),
-        jnp.asarray(split_bit), jnp.asarray(leaves), B, chunk=128,
-        bf16=False))
-    assert out.shape == (2 * K, f, B, 3)
-    for k, leaf in enumerate(leaves):
-        left = (leaf_id == leaf) & split_bit
-        right = (leaf_id == leaf) & ~split_bit
-        for slot, sel in ((k, left), (K + k, right)):
-            ref = _np_histogram(binned[sel], w[sel], B) if sel.any() else \
-                np.zeros((f, B, 3))
-            np.testing.assert_allclose(out[slot], ref, rtol=1e-5, atol=1e-5)
+        jnp.asarray(ids), B, chunk=128, bf16=False))
+    assert out.shape == (C, f, B, 3)
+    for k, leaf in enumerate(ids):
+        sel = leaf_id == leaf
+        ref = _np_histogram(binned[sel], w[sel], B) if sel.any() else \
+            np.zeros((f, B, 3))
+        np.testing.assert_allclose(out[k], ref, rtol=1e-5, atol=1e-5)
 
 
 def test_histogram_masked_leaf():
@@ -238,26 +235,23 @@ def test_leaf_output_formula():
     assert float(leaf_output(0.5, 2.0, 1.0, 0.0)) == pytest.approx(0.0)
 
 
-def test_batched_children_histogram_bf16_single_pass():
+def test_batched_leaves_histogram_bf16_single_pass():
     """The fused hi+lo bf16 contraction must stay within f32-ish tolerance
     and keep counts EXACT (0/1 values are bf16-representable)."""
-    from lightgbm_tpu.ops.histogram import batched_children_histogram
+    from lightgbm_tpu.ops.histogram import batched_leaves_histogram
     rng = np.random.RandomState(7)
-    n, f, B, K = 512, 4, 16, 4
+    n, f, B, C = 512, 4, 16, 4
     binned = rng.randint(0, B, size=(n, f)).astype(np.uint8)
     g = rng.randn(n).astype(np.float32)
     h = rng.rand(n).astype(np.float32)
     w = np.stack([g, h, np.ones(n, np.float32)], axis=1)
     leaf_id = rng.randint(0, 6, size=n).astype(np.int32)
-    split_bit = rng.rand(n) < 0.5
-    leaves = np.asarray([0, 2, 3, 5], np.int32)
-    ref = np.asarray(batched_children_histogram(
+    ids = np.asarray([0, 2, 3, 5], np.int32)
+    ref = np.asarray(batched_leaves_histogram(
         jnp.asarray(binned), jnp.asarray(w), jnp.asarray(leaf_id),
-        jnp.asarray(split_bit), jnp.asarray(leaves), B, chunk=128,
-        bf16=False))
-    fast = np.asarray(batched_children_histogram(
+        jnp.asarray(ids), B, chunk=128, bf16=False))
+    fast = np.asarray(batched_leaves_histogram(
         jnp.asarray(binned), jnp.asarray(w), jnp.asarray(leaf_id),
-        jnp.asarray(split_bit), jnp.asarray(leaves), B, chunk=128,
-        bf16=True))
+        jnp.asarray(ids), B, chunk=128, bf16=True))
     np.testing.assert_allclose(fast, ref, rtol=2e-4, atol=2e-4)
     np.testing.assert_array_equal(fast[:, :, :, 2], ref[:, :, :, 2])

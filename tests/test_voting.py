@@ -1,0 +1,197 @@
+"""Voting-parallel (PV-tree) verification — the three guarantees the
+implementation must honor (reference: voting_parallel_tree_learner.cpp):
+
+1. EXACTNESS AT FULL ELECTION: with top_k >= num_features every feature
+   is elected, the final scan runs at full precision with global sums,
+   and the voting tree must EQUAL the data-parallel tree
+   (cpp:260-430 degenerates to the data-parallel path).
+2. COMMUNICATION: at small top_k the measured cross-shard volume
+   (state.comm_elems) must shrink >= 5x vs data-parallel — voting
+   exchanges O(children * top_k * bins) instead of
+   O(children * features * bins) (cpp:196-258).
+3. ACCURACY: at moderate top_k the trained model's AUC must stay within
+   1% of data-parallel (PV-tree's published property).
+
+Plus a trace-level assertion that the voting psum operand really is the
+elected [C, top_k, B, 3] slice, not the full [C, G, B, 3] histogram —
+a regression that silently reduced the full tensor would pass the
+accuracy tests while destroying the comm win.
+"""
+
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from lightgbm_tpu.dataset import Dataset
+from lightgbm_tpu.learner.grow import (FMETA_KEYS, GrowerConfig,
+                                       TreeGrowerState, grow_tree)
+from lightgbm_tpu.parallel import (DataParallelGrower, VotingParallelGrower,
+                                   make_mesh)
+
+N_FEAT = 40
+
+
+@pytest.fixture(scope="module")
+def problem():
+    rng = np.random.RandomState(3)
+    n = 4096
+    X = rng.randn(n, N_FEAT)
+    score = (X[:, 0] * 1.5 - X[:, 7] + 0.6 * X[:, 13] * X[:, 21]
+             + 0.4 * np.abs(X[:, 30]))
+    y = (score + rng.logistic(size=n) > 0.0).astype(np.float32)
+    ds = Dataset.from_numpy(X, y, max_bin=15, min_data_in_bin=1)
+    grad = (1.0 / (1.0 + np.exp(-score)) - y).astype(np.float32)
+    hess = np.ones(n, np.float32) * 0.25
+    return ds, grad, hess
+
+
+def _cfg(ds, **kw):
+    base = dict(num_leaves=31, max_bins=int(ds.max_num_bin()), chunk=512,
+                lambda_l1=0.0, lambda_l2=0.0, min_gain_to_split=0.0,
+                min_data_in_leaf=20, min_sum_hessian_in_leaf=1e-3,
+                max_depth=-1)
+    base.update(kw)
+    return GrowerConfig(**base)
+
+
+def _run(grower, ds, grad, hess):
+    fm = ds.feature_meta_arrays()
+    return grower(jnp.asarray(ds.binned), jnp.asarray(grad),
+                  jnp.asarray(hess), jnp.ones(ds.num_data, jnp.float32),
+                  jnp.ones(ds.num_features, bool), fm)
+
+
+def test_voting_equals_data_parallel_at_full_top_k(problem):
+    """top_k >= F elects every feature -> trees must be IDENTICAL."""
+    ds, grad, hess = problem
+    mesh = make_mesh(axis_name="data")
+    data_state = _run(DataParallelGrower(mesh, _cfg(ds), axis="data"),
+                      ds, grad, hess)
+    vote_state = _run(VotingParallelGrower(mesh, _cfg(ds), axis="data",
+                                           top_k=N_FEAT),
+                      ds, grad, hess)
+    assert int(vote_state.num_leaves_used) == int(data_state.num_leaves_used)
+    np.testing.assert_array_equal(np.asarray(vote_state.node_feature),
+                                  np.asarray(data_state.node_feature))
+    np.testing.assert_array_equal(np.asarray(vote_state.node_threshold),
+                                  np.asarray(data_state.node_threshold))
+    np.testing.assert_array_equal(np.asarray(vote_state.leaf_id),
+                                  np.asarray(data_state.leaf_id))
+    np.testing.assert_allclose(np.asarray(vote_state.leaf_value),
+                               np.asarray(data_state.leaf_value),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_voting_comm_volume_reduction(problem):
+    """Measured comm at top_k=2 must be >= 5x below data-parallel."""
+    ds, grad, hess = problem
+    mesh = make_mesh(axis_name="data")
+    data_state = _run(DataParallelGrower(mesh, _cfg(ds), axis="data"),
+                      ds, grad, hess)
+    vote_state = _run(VotingParallelGrower(mesh, _cfg(ds), axis="data",
+                                           top_k=2),
+                      ds, grad, hess)
+    # voting must still grow a real tree at top_k=2
+    assert int(vote_state.num_leaves_used) > 10
+    data_comm = float(data_state.comm_elems)
+    vote_comm = float(vote_state.comm_elems)
+    # normalize per pass: pass counts can differ slightly between runs
+    data_per_pass = data_comm / float(data_state.num_passes)
+    vote_per_pass = vote_comm / float(vote_state.num_passes)
+    assert vote_per_pass * 5 <= data_per_pass, \
+        f"voting per-pass comm {vote_per_pass} vs data {data_per_pass}"
+
+
+def test_voting_accuracy_sane_at_moderate_top_k(problem):
+    """End-to-end AUC at top_k=8 within 1% of data-parallel."""
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(4)
+    n = 4096
+    X = rng.randn(n, N_FEAT)
+    score = (X[:, 0] * 1.5 - X[:, 7] + 0.6 * X[:, 13] * X[:, 21])
+    y = (score + rng.logistic(size=n) > 0.0).astype(np.float32)
+
+    def train_auc(tree_learner, top_k=20):
+        params = {"objective": "binary", "metric": "auc", "verbose": -1,
+                  "tree_learner": tree_learner, "top_k": top_k,
+                  "num_leaves": 31, "max_bin": 15}
+        booster = lgb.train(params, lgb.Dataset(X, y), num_boost_round=20,
+                            verbose_eval=False)
+        p = booster.predict(X)
+        from sklearn.metrics import roc_auc_score
+        return roc_auc_score(y, p)
+
+    auc_data = train_auc("data")
+    auc_vote = train_auc("voting", top_k=8)
+    assert auc_vote >= auc_data - 0.01, (auc_vote, auc_data)
+
+
+def test_voting_psum_operand_is_elected_slice(problem):
+    """Trace-level comm check: in voting mode no psum operand may carry a
+    feature-sized histogram axis — only the elected [C, top_k, B, 3]
+    slice (plus scalar-ish reductions) may cross shards."""
+    ds, grad, hess = problem
+    mesh = make_mesh(axis_name="data")
+    top_k = 2
+    cfg = _cfg(ds)._replace(voting=True, top_k=top_k, data_axis="data",
+                            num_data_shards=mesh.shape["data"])
+    fm = {k: jnp.asarray(v) for k, v in ds.feature_meta_arrays().items()}
+    n = ds.num_data
+    nshards = mesh.shape["data"]
+
+    def run(b, g, h, w, fmask, *meta):
+        return grow_tree(b, g, h, w, fmask, *meta, cfg)
+
+    state_spec = TreeGrowerState(
+        **{name: (P("data") if name == "leaf_id" else P())
+           for name in TreeGrowerState._fields})
+    sharded = jax.shard_map(
+        run, mesh=mesh,
+        in_specs=(P("data", None), P("data"), P("data"), P("data"), P(None))
+                 + (P(None),) * 7,
+        out_specs=state_spec, check_vma=False)
+    jaxpr = jax.make_jaxpr(sharded)(
+        jnp.asarray(ds.binned), jnp.asarray(grad), jnp.asarray(hess),
+        jnp.ones(n, jnp.float32), jnp.ones(ds.num_features, bool),
+        *[fm[k] for k in FMETA_KEYS])
+
+    # collect every cross-shard reduction in the (nested) jaxpr
+    found = []
+    seen = set()
+
+    def subjaxprs(v):
+        if hasattr(v, "eqns"):
+            yield v
+        elif hasattr(v, "jaxpr"):
+            yield v.jaxpr
+        elif isinstance(v, (list, tuple)):
+            for x in v:
+                yield from subjaxprs(x)
+
+    def walk(jx):
+        if id(jx) in seen:
+            return
+        seen.add(id(jx))
+        for eq in jx.eqns:
+            if "psum" in eq.primitive.name:
+                found.append([tuple(v.aval.shape) for v in eq.invars][0])
+            for v in eq.params.values():
+                for sub in subjaxprs(v):
+                    walk(sub)
+
+    walk(jaxpr.jaxpr)
+    b = int(ds.max_num_bin())
+    f = ds.num_features
+    deep = [s for s in found if len(s) >= 3]
+    assert deep, "no multi-dim psum found in voting jaxpr (trace changed?)"
+    for shape in deep:
+        # elected slice [C, top_k, B, 3]: a full histogram exchange would
+        # carry the feature-sized axis F here instead of top_k
+        assert f not in shape[1:], \
+            f"voting psum carries a feature-sized axis: {shape}"
+        assert shape[1] == top_k and shape[2] == b, \
+            f"voting psum is not the elected top_k slice: {shape}"
