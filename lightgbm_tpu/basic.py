@@ -371,6 +371,67 @@ class Booster:
         self._metric_names = []
 
     # ------------------------------------------------------------------
+    def _reset_training_data(self, train_set: Dataset) -> "Booster":
+        """Swap the training set, keep the ensemble (reference:
+        Booster::ResetTrainingData, c_api.cpp:95-105 ->
+        GBDT::ResetTrainingData, gbdt.cpp:722-775): objective and metrics
+        re-initialize against the new data and training scores are
+        rebuilt by replaying the existing trees."""
+        import jax.numpy as jnp
+
+        from .ops.predict import predict_value_binned
+
+        old = self._inner
+        models = old.models
+        it = old.iter_
+        inner_train = train_set._lazy_init()
+        # schema guard (the reference fatals on mismatched bin mappers,
+        # Dataset::CheckAlign semantics): a different feature count or
+        # binning would silently replay trees into wrong bins
+        old_ds = old.train_data
+        if old_ds is not None:
+            a = old_ds.feature_meta_arrays()
+            b = inner_train.feature_meta_arrays()
+            same = (old_ds.num_features == inner_train.num_features
+                    and all(np.array_equal(a[key], b[key]) for key in a))
+            if not same:
+                raise LightGBMError(
+                    "Cannot reset training data: feature/bin schema differs "
+                    "from the original dataset (construct the new Dataset "
+                    "with reference= the original)")
+        self.train_set = train_set
+        objective = create_objective(self.config)
+        fresh = create_boosting(self.config.boosting_type, self.config)
+        fresh.init(inner_train, objective, self._metric_names)
+        fresh.models = models
+        fresh.iter_ = it
+        # a GBDT ensemble already carries the boost-from-average bias
+        # inside its first tree (AddBias, gbdt.cpp:445-447) — undo the
+        # fresh init's score bump so the replay doesn't double-count it.
+        # RF trees never fold the bias (rf.py), so its bump stays.
+        if models and not fresh.average_output \
+                and fresh.init_score_bias != 0.0:
+            fresh._score = fresh._score - fresh.init_score_bias
+            fresh._pending_bias = 0.0
+            fresh.init_score_bias = 0.0
+        # replay the ensemble into the new training scores (the
+        # reference's train_score_updater_ rebuild); RF keeps scores as
+        # the running AVERAGE of tree contributions (rf.py:72-81)
+        k = fresh.num_tree_per_iteration
+        acc = jnp.zeros_like(fresh._score)
+        for i, tree in enumerate(models):
+            if tree.num_leaves > 1:
+                acc = acc.at[i % k].add(
+                    predict_value_binned(tree.to_device(), fresh._binned))
+        if fresh.average_output and it > 0:
+            acc = acc / float(it)
+        fresh._score = fresh._score + acc
+        # valid sets carry over untouched (reference keeps them)
+        for vi, vs in enumerate(getattr(old, "valid_sets", [])):
+            fresh.add_valid(vs, old.valid_names[vi], self._metric_names)
+        self._inner = fresh
+        return self
+
     def add_valid(self, data: Dataset, name: str) -> "Booster":
         if data.reference is None and self.train_set is not None:
             data.set_reference(self.train_set)

@@ -68,7 +68,7 @@ class _HostState:
 
 
 def _grow_and_update_impl(score, binned, grad, hess, row_weight, fmask,
-                          shrinkage, fmeta_args, cls, cfg):
+                          shrinkage, n_valid, fmeta_args, cls, cfg):
     """grow one tree + train-score update, fused into ONE device program.
 
     On a relay-attached TPU every eager op dispatch is a host round trip;
@@ -79,7 +79,7 @@ def _grow_and_update_impl(score, binned, grad, hess, row_weight, fmask,
     import jax.numpy as jnp
 
     state = grow_tree(binned, grad, hess, row_weight, fmask, *fmeta_args,
-                      cfg)
+                      cfg, n_valid=n_valid)
     grew = state.num_leaves_used > 1
     leaf_vals = state.leaf_value * shrinkage
     delta = jnp.where(
@@ -91,7 +91,7 @@ def _grow_and_update_impl(score, binned, grad, hess, row_weight, fmask,
 
 
 def _grow_and_update(score, binned, grad, hess, row_weight, fmask,
-                     shrinkage, fmeta_args, cls, cfg):
+                     shrinkage, n_valid, fmeta_args, cls, cfg):
     import jax
     import jax.numpy as jnp
     global _grow_and_update_jit
@@ -100,7 +100,8 @@ def _grow_and_update(score, binned, grad, hess, row_weight, fmask,
             _grow_and_update_impl, static_argnames=("cls", "cfg"))
     return _grow_and_update_jit(score, binned, grad, hess, row_weight,
                                 fmask, jnp.float32(shrinkage),
-                                tuple(fmeta_args), cls=cls, cfg=cfg)
+                                jnp.int32(n_valid), tuple(fmeta_args),
+                                cls=cls, cfg=cfg)
 
 
 _grow_and_update_jit = None
@@ -177,7 +178,18 @@ class GBDT:
         self._chunk = int(min(chunk, max(256, 1 << int(np.ceil(np.log2(max(n, 1)))))))
         row_multiple = self._chunk * (local_dev if nproc > 1 else ndev) \
             if self._tree_learner_kind in ("data", "voting") else self._chunk
-        n_pad = ((n + row_multiple - 1) // row_multiple) * row_multiple
+        m_count = (n + row_multiple - 1) // row_multiple
+        # bucket the padded size into coarse steps (worst case +25% rows:
+        # granule = next_pow2/8) so nearby row counts share one compiled
+        # signature; the grower skips all-padding chunks via a dynamic
+        # trip count (n_valid), so the extra padding costs memory only,
+        # not compute (multi-host runs keep minimal n_valid=None padding
+        # semantics but also bucket, trading some compute for signatures)
+        if m_count > 1:
+            p2 = 1 << (m_count - 1).bit_length()
+            g = max(1, p2 // 8)
+            m_count = ((m_count + g - 1) // g) * g
+        n_pad = m_count * row_multiple
         if nproc > 1:
             # every process must contribute an equal-sized row block to
             # the global array: pad all shards to the largest
@@ -338,12 +350,16 @@ class GBDT:
                 vs = vs + jnp.asarray(isc)[None, :]
         if self.init_score_bias != 0.0:
             vs = vs + self.init_score_bias
-        # replay existing trees (continued training on new valid set)
+        # replay existing trees (continued training on new valid set);
+        # RF keeps scores as the running AVERAGE of contributions
+        acc = jnp.zeros_like(vs)
         for it in range(self.iter_):
             for cls in range(k):
                 tree = self.models[it * k + cls]
-                vs = vs.at[cls].add(predict_value_binned(tree.to_device(), vb))
-        self._valid_score.append(vs)
+                acc = acc.at[cls].add(predict_value_binned(tree.to_device(), vb))
+        if self.average_output and self.iter_ > 0:
+            acc = acc / float(self.iter_)
+        self._valid_score.append(vs + acc)
 
     # ------------------------------------------------------------------
     def _bagging_weights(self, iter_idx: int, grad=None, hess=None) -> np.ndarray:
@@ -380,13 +396,18 @@ class GBDT:
     def _grow(self, grad, hess, row_weight, feature_mask):
         """Dispatch one tree growth to the serial or distributed grower."""
         import jax.numpy as jnp
+        # padding is a row-suffix only in single-process runs (multi-host
+        # assembles per-process blocks, each with its own padding tail)
+        nv = jnp.int32(self._n) if self._num_processes == 1 else None
         if self._dist_grower is not None:
             return self._dist_grower(self._binned, grad, hess, row_weight,
-                                     jnp.asarray(feature_mask), self._fmeta)
+                                     jnp.asarray(feature_mask), self._fmeta,
+                                     n_valid=nv)
         from ..learner.grow import FMETA_KEYS
         return grow_tree(
             self._binned, grad, hess, row_weight, jnp.asarray(feature_mask),
-            *[self._fmeta[k] for k in FMETA_KEYS], self._grower_cfg)
+            *[self._fmeta[k] for k in FMETA_KEYS], self._grower_cfg,
+            n_valid=nv)
 
     # ------------------------------------------------------------------
     def _compute_gradients(self, score) -> Tuple:
@@ -445,6 +466,7 @@ class GBDT:
                     self._score, small = _grow_and_update(
                         self._score, self._binned, grad[cls], hess[cls],
                         row_weight, jnp.asarray(mask), self.shrinkage_rate,
+                        self._n,
                         [self._fmeta[key] for key in FMETA_KEYS], cls,
                         self._grower_cfg)
                 with tracing.phase("tree/extract"):

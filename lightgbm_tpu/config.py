@@ -167,11 +167,10 @@ class TreeConfig:
     gpu_use_dp: bool = False
     tpu_hist_chunk: int = 32768
     tpu_double_precision: bool = False
-    # pending-leaf histogram batching (learner/grow.py prefetch); 1 =
-    # one data pass per split. (32768, 8) measured fastest on-chip:
-    # pass count saturates near batch_k=8 while the unrolled routing
-    # cost keeps growing with K
-    tpu_batch_k: int = 8
+    # speculative-expansion width (learner/grow.py): nodes expanded per
+    # histogram pass; 1 = one data pass per split. 12 fills the 128-lane
+    # MXU output tile (2*12*(3+2) channels) and measured fastest on-chip
+    tpu_batch_k: int = 12
     # bf16 hi+lo MXU histogram contraction (ops/histogram.py)
     tpu_hist_bf16: bool = True
 

@@ -409,7 +409,7 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
               fmeta_default_bin: jnp.ndarray, fmeta_is_cat: jnp.ndarray,
               fmeta_group: jnp.ndarray, fmeta_offset: jnp.ndarray,
               fmeta_is_bundled: jnp.ndarray,
-              cfg: GrowerConfig):
+              cfg: GrowerConfig, n_valid=None):
     """Grow one leaf-wise tree.
 
     Args:
@@ -421,6 +421,12 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
       row_weight: [N] f32 bagging weight (0 = excluded, GOSS weights > 0).
       feature_mask: [F] bool per-tree feature_fraction sample.
       fmeta_*: per-LOGICAL-feature metadata (Dataset.feature_meta_arrays).
+      n_valid: optional traced GLOBAL count of real (non-padding) rows.
+        Padding must be a row-suffix; histogram passes then skip the
+        all-padding chunks with a dynamic trip count, which lets the GBDT
+        layer bucket row counts into shared compiled signatures at ~zero
+        padding cost. Under data_axis the per-shard count is derived from
+        the shard's position (padding lives in the last shards).
     Returns: TreeGrowerState — the host wraps the node arrays and converts
       bin thresholds to raw-space values.
     """
@@ -455,6 +461,16 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
 
     voting = cfg.voting and cfg.data_axis is not None
 
+    if n_valid is None:
+        nv_local = None
+    elif cfg.data_axis is not None:
+        # rows are sharded in contiguous blocks of n; global padding is a
+        # suffix, so this shard's real-row count clamps into [0, n]
+        nv_local = jnp.clip(
+            n_valid - jax.lax.axis_index(cfg.data_axis) * n, 0, n)
+    else:
+        nv_local = jnp.minimum(n_valid, n)
+
     def reduce_hist(h):
         """Data-axis reduction seam (the ReduceScatter of
         data_parallel_tree_learner.cpp:148-163 — XLA picks the schedule).
@@ -474,7 +490,7 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     # --- root (BeforeTrain: serial_tree_learner.cpp:234-323) ------------
     root_hist = reduce_hist(
         hist_ops.leaf_histogram(local_binned, w3, B, cfg.chunk,
-                                bf16=cfg.hist_bf16))
+                                bf16=cfg.hist_bf16, n_valid=nv_local))
     # global leaf sums: the reference Allreduces (cnt, sum_g, sum_h)
     # (data_parallel_tree_learner.cpp:117-145); summing any feature's bins
     # of the already-reduced histogram gives the same totals
@@ -614,7 +630,7 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                                  jnp.where(valid, cr, -1)])
         hists = reduce_hist(hist_ops.batched_leaves_histogram(
             local_binned, w3, leaf_id, ids2k, B, cfg.chunk,
-            bf16=cfg.hist_bf16))                             # [2K, fl, B, 3]
+            bf16=cfg.hist_bf16, n_valid=nv_local))           # [2K, fl, B, 3]
 
         # children aggregates from the parents' cached split stats
         sel_c = jnp.clip(sel, 0, M - 1)
@@ -734,18 +750,27 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             num_leaves_used=carry.num_leaves_used + 1,
         )
 
+    def _can_commit(carry: _Carry):
+        t = carry.table
+        f_gain = jnp.where(t.frontier, t.gain, neg_inf)
+        l = jnp.argmax(f_gain).astype(jnp.int32)
+        return ((f_gain[l] > 0.0) & t.expanded[l]
+                & (carry.num_leaves_used < L))
+
     def round_body(carry: _Carry) -> _Carry:
         carry = expand(carry)
 
-        def inner(j, carry):
-            t = carry.table
-            f_gain = jnp.where(t.frontier, t.gain, neg_inf)
-            l = jnp.argmax(f_gain).astype(jnp.int32)
-            can = ((f_gain[l] > 0.0) & t.expanded[l]
-                   & (carry.num_leaves_used < L))
-            return jax.lax.cond(can, commit_one, lambda c: c, carry)
+        # drain: commit in strict argmax order until the argmax is an
+        # unexpanded node (next round's forced expansion) or the round's
+        # commit budget is spent. A while_loop (not fori+cond) so empty
+        # drain steps cost nothing and committed state never round-trips
+        # through cond branches.
+        start = carry.num_leaves_used
 
-        return jax.lax.fori_loop(0, C, inner, carry)
+        def drain_cond(carry):
+            return (carry.num_leaves_used - start < C) & _can_commit(carry)
+
+        return jax.lax.while_loop(drain_cond, commit_one, carry)
 
     def round_cond(carry: _Carry):
         t = carry.table

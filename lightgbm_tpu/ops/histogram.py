@@ -76,7 +76,7 @@ def _onehot(binned_chunk: jnp.ndarray, num_bins: int) -> jnp.ndarray:
 @functools.partial(jax.jit, static_argnames=("num_bins", "chunk", "bf16"))
 def leaf_histogram(binned: jnp.ndarray, weights: jnp.ndarray,
                    num_bins: int, chunk: int = 16384,
-                   bf16: bool = True) -> jnp.ndarray:
+                   bf16: bool = True, n_valid=None) -> jnp.ndarray:
     """hist[f, b, (g,h,cnt)] over rows where the mask channel is nonzero.
 
     Args:
@@ -86,28 +86,32 @@ def leaf_histogram(binned: jnp.ndarray, weights: jnp.ndarray,
                fold into the channels (GOSS amplification multiplies grad
                and hess, the count channel stays 0/1 — goss.hpp:87-131).
       num_bins: histogram width B (max bins over features).
+      n_valid: optional traced row count; rows beyond it are PADDING (the
+               loader pads as a suffix) and their chunks are skipped by a
+               dynamic trip count — row-count buckets can then share one
+               compiled signature with ~zero cost for the padding.
     Returns: [F, B, 3] float32.
     """
     n, f = binned.shape
     if n % chunk != 0:
         raise ValueError(f"rows ({n}) must be padded to a multiple of chunk ({chunk})")
     n_chunks = n // chunk
-    binned_c = binned.reshape(n_chunks, chunk, f)
-    w_c = weights.reshape(n_chunks, chunk, 3)
 
-    def one(b_chunk, w_chunk):
+    def one(c):
+        b_chunk = jax.lax.dynamic_slice(binned, (c * chunk, 0), (chunk, f))
+        w_chunk = jax.lax.dynamic_slice(weights, (c * chunk, 0), (chunk, 3))
         return _contract(_onehot(b_chunk, num_bins), w_chunk, bf16)
 
     if n_chunks == 1:
-        return one(binned_c[0], w_c[0])
+        return one(jnp.int32(0))
 
-    def body(acc, xs):
-        b_chunk, w_chunk = xs
-        return acc + one(b_chunk, w_chunk), None
+    def body(c, acc):
+        return acc + one(c)
 
+    trip = n_chunks if n_valid is None else \
+        jnp.minimum((n_valid + chunk - 1) // chunk, n_chunks)
     init = jnp.zeros((f, num_bins, 3), dtype=jnp.float32)
-    hist, _ = jax.lax.scan(body, init, (binned_c, w_c))
-    return hist
+    return jax.lax.fori_loop(0, trip, body, init)
 
 
 @functools.partial(jax.jit,
@@ -115,7 +119,7 @@ def leaf_histogram(binned: jnp.ndarray, weights: jnp.ndarray,
 def batched_leaves_histogram(binned: jnp.ndarray, weights: jnp.ndarray,
                              leaf_id: jnp.ndarray, ids: jnp.ndarray,
                              num_bins: int, chunk: int = 16384,
-                             bf16: bool = True) -> jnp.ndarray:
+                             bf16: bool = True, n_valid=None) -> jnp.ndarray:
     """Histograms of C arbitrary leaf-label ids in one data pass.
 
     The speculative grower (learner/grow.py) relabels rows to child node
@@ -165,8 +169,10 @@ def batched_leaves_histogram(binned: jnp.ndarray, weights: jnp.ndarray,
         def body(c, acc):
             return acc + one(c)
 
+        trip = n_chunks if n_valid is None else \
+            jnp.minimum((n_valid + chunk - 1) // chunk, n_chunks)
         init = jnp.zeros((f, num_bins, c_ids * 3), dtype=jnp.float32)
-        hist = jax.lax.fori_loop(0, n_chunks, body, init)
+        hist = jax.lax.fori_loop(0, trip, body, init)
     return hist.reshape(f, num_bins, c_ids, 3).transpose(2, 0, 1, 3)
 
 

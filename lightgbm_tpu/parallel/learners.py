@@ -63,7 +63,7 @@ class DataParallelGrower:
         self._global_binned_id = None
 
     def __call__(self, binned, grad, hess, row_weight, feature_mask,
-                 fmeta: Dict):
+                 fmeta: Dict, n_valid=None):
         cfg = self.cfg
         ax = self.axis
         # multi-host: inputs arrive as THIS PROCESS's row shard — assemble
@@ -92,16 +92,21 @@ class DataParallelGrower:
         # out_specs: leaf_id stays sharded by rows; everything else is
         # replicated (identical on all shards by construction)
         state_spec = self._state_specs()
+        from ..learner.grow import FMETA_KEYS
+        # n_valid=None means "all rows real" — identical to the padded
+        # row count, so one shard_map signature serves both
+        if n_valid is None:
+            n_valid = binned.shape[0]
         run = jax.shard_map(
-            lambda b, g, h, w, fm, *meta: grow_tree(b, g, h, w, fm, *meta, cfg),
+            lambda b, g, h, w, fm, nv, *meta: grow_tree(
+                b, g, h, w, fm, *meta, cfg, n_valid=nv),
             mesh=self.mesh,
-            in_specs=(P(ax, None), P(ax), P(ax), P(ax), P(None))
+            in_specs=(P(ax, None), P(ax), P(ax), P(ax), P(None), P())
                      + (P(None),) * 7,
             out_specs=state_spec,
             check_vma=False)
-        from ..learner.grow import FMETA_KEYS
         return run(binned, grad, hess, row_weight, feature_mask,
-                   *[fmeta[k] for k in FMETA_KEYS])
+                   jnp.int32(n_valid), *[fmeta[k] for k in FMETA_KEYS])
 
     def _state_specs(self):
         from ..learner.grow import TreeGrowerState
@@ -144,22 +149,25 @@ class FeatureParallelGrower:
         fmeta["is_bundled"] = np.concatenate([fmeta["is_bundled"], np.zeros(extra, bool)])
         return binned, fmeta
 
-    def __call__(self, binned, grad, hess, row_weight, feature_mask, fmeta):
+    def __call__(self, binned, grad, hess, row_weight, feature_mask, fmeta,
+                 n_valid=None):
         cfg = self.cfg
         ax = self.axis
-        from ..learner.grow import TreeGrowerState
+        from ..learner.grow import FMETA_KEYS, TreeGrowerState
         fields = {name: P() for name in TreeGrowerState._fields}
         state_spec = TreeGrowerState(**fields)
+        if n_valid is None:
+            n_valid = binned.shape[0]
         run = jax.shard_map(
-            lambda b, g, h, w, fm, *meta: grow_tree(b, g, h, w, fm, *meta, cfg),
+            lambda b, g, h, w, fm, nv, *meta: grow_tree(
+                b, g, h, w, fm, *meta, cfg, n_valid=nv),
             mesh=self.mesh,
-            in_specs=(P(None, None), P(None), P(None), P(None), P(None))
-                     + (P(None),) * 7,
+            in_specs=(P(None, None), P(None), P(None), P(None), P(None),
+                      P()) + (P(None),) * 7,
             out_specs=state_spec,
             check_vma=False)
-        from ..learner.grow import FMETA_KEYS
         return run(binned, grad, hess, row_weight, feature_mask,
-                   *[fmeta[k] for k in FMETA_KEYS])
+                   jnp.int32(n_valid), *[fmeta[k] for k in FMETA_KEYS])
 
 
 class VotingParallelGrower(DataParallelGrower):

@@ -85,8 +85,15 @@ FUNCS = [
     ("LGBM_BoosterMerge", [("void*", "handle"), ("void*", "other_handle")]),
     ("LGBM_BoosterAddValidData",
      [("void*", "handle"), ("const void*", "valid_data")]),
+    ("LGBM_BoosterResetTrainingData",
+     [("void*", "handle"), ("const void*", "train_data")]),
     ("LGBM_BoosterResetParameter",
      [("void*", "handle"), ("const char*", "parameters")]),
+    ("LGBM_BoosterGetNumPredict",
+     [("void*", "handle"), ("int", "data_idx"), ("int64_t*", "out_len")]),
+    ("LGBM_BoosterGetPredict",
+     [("void*", "handle"), ("int", "data_idx"), ("int64_t*", "out_len"),
+      ("double*", "out_result")]),
     ("LGBM_BoosterGetNumClasses", [("void*", "handle"), ("int*", "out_len")]),
     ("LGBM_BoosterUpdateOneIter",
      [("void*", "handle"), ("int*", "is_finished")]),

@@ -43,7 +43,7 @@ def main():
     # microbenchmarks compile to different buffer placements than the
     # training loop, so only the in-loop number is honest
     from lightgbm_tpu.learner.grow import FMETA_KEYS, GrowerConfig, make_grower
-    N, F, B, K = 524288, 28, 64, 8
+    N, F, B, K = 524288, 28, 64, 12
     chunk = 32768
     rng = np.random.RandomState(0)
     binned = jnp.asarray(rng.randint(0, B, size=(N, F)).astype(np.uint8))

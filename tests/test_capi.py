@@ -422,3 +422,122 @@ def test_save_model_to_string_truncation_semantics():
     assert b"tree" in big.value
     capi.LGBM_BoosterFree(b)
     capi.LGBM_DatasetFree(d)
+
+
+def test_get_predict_inner_scores():
+    """LGBM_BoosterGetNumPredict/GetPredict (c_api.h:488/:502): inner
+    train/valid predictions, objective-converted, class-major layout —
+    must match Booster.predict on the same rows."""
+    X, y = _make_mat(300, 5, seed=3)
+    Xv, yv = _make_mat(100, 5, seed=4)
+    train = _dataset_from_mat(X, y)
+    valid = _dataset_from_mat(Xv, yv, ref=train)
+    bh = _vp()
+    assert capi.LGBM_BoosterCreate(
+        train, ctypes.c_char_p(b"objective=binary verbose=-1 num_leaves=15"),
+        ctypes.addressof(bh)) == 0
+    assert capi.LGBM_BoosterAddValidData(bh, valid) == 0
+    fin = ctypes.c_int(0)
+    for _ in range(5):
+        assert capi.LGBM_BoosterUpdateOneIter(bh, ctypes.addressof(fin)) == 0
+
+    for data_idx, n_expect, feats in ((0, 300, X), (1, 100, Xv)):
+        out_len = ctypes.c_int64(0)
+        assert capi.LGBM_BoosterGetNumPredict(
+            bh, data_idx, ctypes.addressof(out_len)) == 0
+        assert out_len.value == n_expect
+        buf = np.zeros(n_expect, np.float64)
+        assert capi.LGBM_BoosterGetPredict(
+            bh, data_idx, ctypes.addressof(out_len), buf.ctypes.data) == 0
+        assert out_len.value == n_expect
+        # converted probabilities, equal to the public predict path
+        assert (buf > 0).all() and (buf < 1).all()
+        from lightgbm_tpu import capi as _c
+        _, booster = _c._get(bh)
+        np.testing.assert_allclose(buf, booster.predict(feats),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_reset_training_data_keeps_model_and_continues():
+    """LGBM_BoosterResetTrainingData (c_api.h:379): swap the training set,
+    keep the ensemble, continue training on the new data (the reference's
+    bagging-subset / refit seam)."""
+    X1, y1 = _make_mat(300, 5, seed=5)
+    X2, y2 = _make_mat(400, 5, seed=6)
+    d1 = _dataset_from_mat(X1, y1)
+    bh = _vp()
+    assert capi.LGBM_BoosterCreate(
+        d1, ctypes.c_char_p(b"objective=binary verbose=-1 num_leaves=15"),
+        ctypes.addressof(bh)) == 0
+    fin = ctypes.c_int(0)
+    for _ in range(4):
+        assert capi.LGBM_BoosterUpdateOneIter(bh, ctypes.addressof(fin)) == 0
+    it = ctypes.c_int(0)
+    assert capi.LGBM_BoosterGetCurrentIteration(bh, ctypes.addressof(it)) == 0
+    assert it.value == 4
+
+    d2 = _dataset_from_mat(X2, y2, ref=d1)
+    assert capi.LGBM_BoosterResetTrainingData(bh, d2) == 0, \
+        capi.LGBM_GetLastError()
+    # ensemble preserved
+    assert capi.LGBM_BoosterGetCurrentIteration(bh, ctypes.addressof(it)) == 0
+    assert it.value == 4
+    # inner predict now reports the NEW training set's size, with scores
+    # replayed from the kept ensemble
+    out_len = ctypes.c_int64(0)
+    assert capi.LGBM_BoosterGetNumPredict(bh, 0, ctypes.addressof(out_len)) == 0
+    assert out_len.value == 400
+    buf = np.zeros(400, np.float64)
+    assert capi.LGBM_BoosterGetPredict(
+        bh, 0, ctypes.addressof(out_len), buf.ctypes.data) == 0
+    from lightgbm_tpu import capi as _c
+    _, booster = _c._get(bh)
+    np.testing.assert_allclose(buf, booster.predict(X2), rtol=1e-5, atol=1e-6)
+    # and training continues on the new data
+    for _ in range(3):
+        assert capi.LGBM_BoosterUpdateOneIter(bh, ctypes.addressof(fin)) == 0
+    assert capi.LGBM_BoosterGetCurrentIteration(bh, ctypes.addressof(it)) == 0
+    assert it.value == 7
+
+
+def test_reset_training_data_rf_preserves_average():
+    """RF keeps scores as the running AVERAGE of tree contributions
+    (rf.py:72-81) — ResetTrainingData must replay with the same
+    normalization, not the GBDT sum."""
+    X, y = _make_mat(300, 5, seed=9)
+    d1 = _dataset_from_mat(X, y)
+    bh = _vp()
+    assert capi.LGBM_BoosterCreate(
+        d1, ctypes.c_char_p(
+            b"objective=binary boosting=rf verbose=-1 num_leaves=15 "
+            b"feature_fraction=0.8 bagging_fraction=0.8 bagging_freq=1"),
+        ctypes.addressof(bh)) == 0
+    fin = ctypes.c_int(0)
+    for _ in range(4):
+        assert capi.LGBM_BoosterUpdateOneIter(bh, ctypes.addressof(fin)) == 0
+    from lightgbm_tpu import capi as _c
+    _, booster = _c._get(bh)
+    before = np.asarray(booster._inner._score).copy()
+
+    d2 = _dataset_from_mat(X, y, ref=d1)   # same rows -> scores must match
+    assert capi.LGBM_BoosterResetTrainingData(bh, d2) == 0, \
+        capi.LGBM_GetLastError()
+    _, booster = _c._get(bh)
+    after = np.asarray(booster._inner._score)
+    np.testing.assert_allclose(after[:, :300], before[:, :300],
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_reset_training_data_rejects_schema_mismatch():
+    X1, y1 = _make_mat(300, 5, seed=10)
+    X2, y2 = _make_mat(300, 7, seed=11)    # different feature count
+    d1 = _dataset_from_mat(X1, y1)
+    bh = _vp()
+    assert capi.LGBM_BoosterCreate(
+        d1, ctypes.c_char_p(b"objective=binary verbose=-1 num_leaves=15"),
+        ctypes.addressof(bh)) == 0
+    fin = ctypes.c_int(0)
+    assert capi.LGBM_BoosterUpdateOneIter(bh, ctypes.addressof(fin)) == 0
+    d2 = _dataset_from_mat(X2, y2)
+    assert capi.LGBM_BoosterResetTrainingData(bh, d2) != 0
+    assert "schema" in str(capi.LGBM_GetLastError())
