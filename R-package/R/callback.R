@@ -1,0 +1,92 @@
+# Training callbacks (reference surface: R-package/R/callback.R —
+# cb.print.evaluation, cb.record.evaluation, cb.reset.parameter,
+# cb.early.stop). Our own implementation: a callback is a function(env)
+# where env carries booster/iteration/eval results, with a `before`
+# attribute deciding whether it runs pre- or post-update.
+
+cb.print.evaluation <- function(period = 1L) {
+  callback <- function(env) {
+    if (period <= 0L || length(env$eval_list) == 0L) return(invisible(NULL))
+    if ((env$iteration - 1L) %% period != 0L) return(invisible(NULL))
+    msgs <- vapply(env$eval_list, function(e) {
+      sprintf("%s's %s:%g", e$data_name, e$name, e$value)
+    }, character(1L))
+    cat(sprintf("[%d]\t%s\n", env$iteration, paste(msgs, collapse = "\t")))
+    invisible(NULL)
+  }
+  attr(callback, "name") <- "cb.print.evaluation"
+  callback
+}
+
+cb.record.evaluation <- function() {
+  callback <- function(env) {
+    for (e in env$eval_list) {
+      rec <- env$booster$record_evals
+      if (is.null(rec[[e$data_name]])) rec[[e$data_name]] <- list()
+      if (is.null(rec[[e$data_name]][[e$name]])) {
+        rec[[e$data_name]][[e$name]] <- list(eval = list(), err = list())
+      }
+      rec[[e$data_name]][[e$name]]$eval <-
+        c(rec[[e$data_name]][[e$name]]$eval, e$value)
+      env$booster$record_evals <- rec
+    }
+    invisible(NULL)
+  }
+  attr(callback, "name") <- "cb.record.evaluation"
+  callback
+}
+
+cb.reset.parameter <- function(new_params) {
+  callback <- function(env) {
+    params <- lapply(new_params, function(p) {
+      if (is.function(p)) p(env$iteration, env$end_iteration) else
+        p[min(env$iteration, length(p))]
+    })
+    env$booster$reset_parameter(params)
+    invisible(NULL)
+  }
+  attr(callback, "name") <- "cb.reset.parameter"
+  attr(callback, "before") <- TRUE
+  callback
+}
+
+cb.early.stop <- function(stopping_rounds, verbose = TRUE) {
+  best_score <- NULL
+  best_iter <- NULL
+  callback <- function(env) {
+    evals <- Filter(function(e) e$data_name != "training", env$eval_list)
+    if (length(evals) == 0L) return(invisible(NULL))
+    if (is.null(best_score)) {
+      best_score <<- rep(NA_real_, length(evals))
+      best_iter <<- rep(0L, length(evals))
+    }
+    for (i in seq_along(evals)) {
+      e <- evals[[i]]
+      better <- is.na(best_score[i]) ||
+        (e$higher_better && e$value > best_score[i]) ||
+        (!e$higher_better && e$value < best_score[i])
+      if (better) {
+        best_score[i] <<- e$value
+        best_iter[i] <<- env$iteration
+      } else if (env$iteration - best_iter[i] >= stopping_rounds) {
+        env$booster$best_iter <- best_iter[i]
+        if (verbose) {
+          cat(sprintf(
+            "Early stopping, best iteration is %d (%s %s:%g)\n",
+            best_iter[i], e$data_name, e$name, best_score[i]))
+        }
+        env$met_early_stop <- TRUE
+      }
+    }
+    invisible(NULL)
+  }
+  attr(callback, "name") <- "cb.early.stop"
+  callback
+}
+
+# internal: partition callbacks into pre-/post-update sets
+.lgb_categorize_callbacks <- function(callbacks) {
+  before <- Filter(function(cb) isTRUE(attr(cb, "before")), callbacks)
+  after <- Filter(function(cb) !isTRUE(attr(cb, "before")), callbacks)
+  list(before = before, after = after)
+}

@@ -1,0 +1,276 @@
+# lgb.Booster — training handle + prediction.
+#
+# API parity with the reference R-package/R/lgb.Booster.R and
+# lgb.Predictor.R (update, rollback, eval, save/load/dump, predict with
+# rawscore/leafidx, lgb.get.eval.result); our own R6 implementation over
+# the .Call glue (src/lightgbm_tpu_R.c).
+
+Booster <- R6::R6Class(
+  classname = "lgb.Booster",
+  cloneable = FALSE,
+  public = list(
+    best_iter = -1L,
+    record_evals = list(),
+
+    initialize = function(params = list(), train_set = NULL,
+                          modelfile = NULL, model_str = NULL) {
+      if (!is.null(train_set)) {
+        train_set$construct()
+        private$train_set <- train_set
+        private$num_dataset <- 1L
+        pstr <- lgb.params2str(params)
+        private$handle <- lgb.call(
+          "LGBM_BoosterCreate_R", train_set$get_handle(), pstr,
+          ret = lgb.null.handle())
+      } else if (!is.null(modelfile)) {
+        private$handle <- lgb.call(
+          "LGBM_BoosterCreateFromModelfile_R", path.expand(modelfile),
+          ret = lgb.null.handle())
+      } else if (!is.null(model_str)) {
+        private$handle <- lgb.call(
+          "LGBM_BoosterLoadModelFromString_R", model_str,
+          ret = lgb.null.handle())
+      } else {
+        stop("lgb.Booster: need train_set, modelfile or model_str")
+      }
+      class(self) <- c("lgb.Booster", class(self))
+      invisible(self)
+    },
+
+    add_valid = function(data, name) {
+      data$construct()
+      lgb.call("LGBM_BoosterAddValidData_R", private$handle,
+               data$get_handle())
+      private$valid_sets <- c(private$valid_sets, list(data))
+      private$name_valid_sets <- c(private$name_valid_sets, name)
+      private$num_dataset <- private$num_dataset + 1L
+      invisible(self)
+    },
+
+    reset_parameter = function(params) {
+      lgb.call("LGBM_BoosterResetParameter_R", private$handle,
+               lgb.params2str(params))
+      invisible(self)
+    },
+
+    reset_training_data = function(train_set) {
+      train_set$construct()
+      lgb.call("LGBM_BoosterResetTrainingData_R", private$handle,
+               train_set$get_handle())
+      private$train_set <- train_set
+      invisible(self)
+    },
+
+    update = function(train_set = NULL, fobj = NULL) {
+      if (!is.null(train_set)) {
+        self$reset_training_data(train_set)
+      }
+      if (is.null(fobj)) {
+        lgb.call("LGBM_BoosterUpdateOneIter_R", private$handle)
+      } else {
+        preds <- private$inner_predict(0L)
+        gpair <- fobj(preds, private$train_set)
+        lgb.call("LGBM_BoosterUpdateOneIterCustom_R", private$handle,
+                 as.numeric(gpair$grad), as.numeric(gpair$hess),
+                 length(gpair$grad))
+      }
+      invisible(self)
+    },
+
+    rollback_one_iter = function() {
+      lgb.call("LGBM_BoosterRollbackOneIter_R", private$handle)
+      invisible(self)
+    },
+
+    current_iter = function() {
+      lgb.call.return.int("LGBM_BoosterGetCurrentIteration_R",
+                          private$handle)
+    },
+
+    eval = function(data, name, feval = NULL) {
+      idx <- if (identical(data, private$train_set)) 0L else {
+        m <- match(list(data), private$valid_sets)
+        if (is.na(m)) stop("eval: dataset not added via add_valid")
+        m
+      }
+      private$inner_eval(name, idx, feval)
+    },
+
+    eval_train = function(feval = NULL) {
+      private$inner_eval("training", 0L, feval)
+    },
+
+    eval_valid = function(feval = NULL) {
+      out <- list()
+      for (i in seq_along(private$valid_sets)) {
+        out <- c(out, private$inner_eval(private$name_valid_sets[i], i,
+                                         feval))
+      }
+      out
+    },
+
+    save_model = function(filename, num_iteration = -1L) {
+      lgb.call("LGBM_BoosterSaveModel_R", private$handle,
+               as.integer(num_iteration), path.expand(filename))
+      invisible(self)
+    },
+
+    save_model_to_string = function(num_iteration = -1L) {
+      lgb.call.return.str("LGBM_BoosterSaveModelToString_R",
+                          private$handle, as.integer(num_iteration))
+    },
+
+    dump_model = function(num_iteration = -1L) {
+      lgb.call.return.str("LGBM_BoosterDumpModel_R", private$handle,
+                          as.integer(num_iteration))
+    },
+
+    predict = function(data, num_iteration = -1L, rawscore = FALSE,
+                       predleaf = FALSE, header = FALSE, reshape = FALSE) {
+      if (is.character(data)) {
+        tmp <- tempfile()
+        lgb.call("LGBM_BoosterPredictForFile_R", private$handle,
+                 path.expand(data), as.integer(header),
+                 as.integer(rawscore), as.integer(predleaf),
+                 as.integer(num_iteration), "", tmp)
+        out <- as.matrix(read.table(tmp, sep = "\t"))
+        file.remove(tmp)
+        if (ncol(out) == 1L && !reshape) return(as.numeric(out[, 1L]))
+        return(out)
+      }
+      nrow_ <- nrow(data)
+      len <- lgb.call.return.int(
+        "LGBM_BoosterCalcNumPredict_R", private$handle,
+        as.integer(nrow_), as.integer(rawscore),
+        as.integer(predleaf), as.integer(num_iteration))
+      out <- numeric(len)
+      if (inherits(data, "dgCMatrix")) {
+        out <- lgb.call("LGBM_BoosterPredictForCSC_R", private$handle,
+                        data@p, data@i, data@x, length(data@p),
+                        length(data@x), nrow_, as.integer(rawscore),
+                        as.integer(predleaf), as.integer(num_iteration),
+                        "", ret = out)
+      } else {
+        data <- as.matrix(data)
+        storage.mode(data) <- "double"
+        out <- lgb.call("LGBM_BoosterPredictForMat_R", private$handle,
+                        data, nrow(data), ncol(data),
+                        as.integer(rawscore), as.integer(predleaf),
+                        as.integer(num_iteration), "", ret = out)
+      }
+      per_row <- len %/% nrow_
+      if (per_row > 1L || reshape) {
+        # row-major [nrow, per_row] from the C ABI
+        matrix(out, nrow = nrow_, ncol = per_row, byrow = TRUE)
+      } else {
+        out
+      }
+    },
+
+    get_handle = function() private$handle,
+
+    num_class = function() {
+      lgb.call.return.int("LGBM_BoosterGetNumClasses_R", private$handle)
+    },
+
+    finalize = function() {
+      if (!is.null(private$handle)) {
+        tryCatch(lgb.call("LGBM_BoosterFree_R", private$handle),
+                 error = function(e) NULL)
+        private$handle <- NULL
+      }
+    }
+  ),
+  private = list(
+    handle = NULL, train_set = NULL, valid_sets = list(),
+    name_valid_sets = character(0), num_dataset = 0L,
+    eval_names = NULL,
+
+    get_eval_names = function() {
+      if (is.null(private$eval_names)) {
+        joined <- lgb.call.return.str("LGBM_BoosterGetEvalNames_R",
+                                      private$handle)
+        private$eval_names <- if (nzchar(joined))
+          strsplit(joined, "\n", fixed = TRUE)[[1L]] else character(0)
+      }
+      private$eval_names
+    },
+
+    inner_predict = function(data_idx) {
+      n <- lgb.call.return.int("LGBM_BoosterGetNumPredict_R",
+                               private$handle, as.integer(data_idx))
+      out <- numeric(n)
+      lgb.call("LGBM_BoosterGetPredict_R", private$handle,
+               as.integer(data_idx), ret = out)
+    },
+
+    inner_eval = function(data_name, data_idx, feval = NULL) {
+      names <- private$get_eval_names()
+      out <- list()
+      if (length(names) > 0L) {
+        vals <- numeric(length(names))
+        vals <- lgb.call("LGBM_BoosterGetEval_R", private$handle,
+                         as.integer(data_idx), ret = vals)
+        for (i in seq_along(names)) {
+          out[[length(out) + 1L]] <- list(
+            data_name = data_name, name = names[i], value = vals[i],
+            higher_better = .lgb_higher_better(names[i]))
+        }
+      }
+      if (!is.null(feval)) {
+        preds <- private$inner_predict(data_idx)
+        ds <- if (data_idx == 0L) private$train_set
+              else private$valid_sets[[data_idx]]
+        res <- feval(preds, ds)
+        out[[length(out) + 1L]] <- list(
+          data_name = data_name, name = res$name, value = res$value,
+          higher_better = isTRUE(res$higher_better))
+      }
+      out
+    }
+  )
+)
+
+#' Predict method for lgb.Booster.
+predict.lgb.Booster <- function(object, data, num_iteration = -1L,
+                                rawscore = FALSE, predleaf = FALSE,
+                                header = FALSE, reshape = FALSE, ...) {
+  object$predict(data, num_iteration = num_iteration, rawscore = rawscore,
+                 predleaf = predleaf, header = header, reshape = reshape)
+}
+
+#' Load a model from a text file.
+lgb.load <- function(filename = NULL, model_str = NULL) {
+  if (!is.null(filename)) {
+    Booster$new(modelfile = filename)
+  } else if (!is.null(model_str)) {
+    Booster$new(model_str = model_str)
+  } else {
+    stop("lgb.load: need filename or model_str")
+  }
+}
+
+#' Save a model to a text file.
+lgb.save <- function(booster, filename, num_iteration = -1L) {
+  booster$save_model(filename, num_iteration)
+}
+
+#' Dump a model to JSON.
+lgb.dump <- function(booster, num_iteration = -1L) {
+  booster$dump_model(num_iteration)
+}
+
+#' Extract a recorded metric series from lgb.train / lgb.cv output.
+lgb.get.eval.result <- function(booster, data_name, eval_name,
+                                iters = NULL, is_err = FALSE) {
+  rec <- booster$record_evals[[data_name]][[eval_name]]
+  if (is.null(rec)) stop("lgb.get.eval.result: no such record")
+  key <- if (is_err) "err" else "eval"
+  if (is_err && length(rec$err) == 0L) {
+    stop("lgb.get.eval.result: no error-bar record ",
+         "(err is populated by lgb.cv aggregation only)")
+  }
+  out <- unlist(rec[[key]])
+  if (!is.null(iters)) out <- out[iters]
+  out
+}

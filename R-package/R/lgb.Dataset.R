@@ -1,0 +1,264 @@
+# lgb.Dataset — the binned training-data container.
+#
+# API parity with the reference R-package/R/lgb.Dataset.R (constructor,
+# construct, dim/dimnames, slice, getinfo/setinfo, save_binary,
+# set_categorical_feature, set_reference, lgb.Dataset.create.valid); the
+# implementation is our own R6 wrapper over the .Call glue
+# (src/lightgbm_tpu_R.c) into lib_lightgbm_tpu.so.
+
+Dataset <- R6::R6Class(
+  classname = "lgb.Dataset",
+  cloneable = FALSE,
+  public = list(
+    initialize = function(data = NULL, params = list(), reference = NULL,
+                          colnames = NULL, categorical_feature = NULL,
+                          free_raw_data = TRUE, used_indices = NULL,
+                          info = list(), ...) {
+      extra <- list(...)
+      for (key in c("label", "weight", "group", "init_score")) {
+        if (!is.null(extra[[key]])) info[[key]] <- extra[[key]]
+      }
+      private$raw_data <- data
+      private$params <- params
+      private$reference <- reference
+      private$colnames_ <- colnames
+      private$categorical_feature <- categorical_feature
+      private$free_raw_data <- isTRUE(free_raw_data)
+      private$used_indices <- used_indices
+      private$info <- info
+      private$handle <- NULL
+      invisible(self)
+    },
+
+    construct = function() {
+      if (!is.null(private$handle)) return(invisible(self))
+      params <- private$params
+      if (!is.null(private$categorical_feature)) {
+        cf <- private$categorical_feature
+        if (is.character(cf)) {
+          cf <- match(cf, private$colnames_) - 1L
+          if (anyNA(cf)) stop("categorical_feature name not found")
+        } else {
+          cf <- as.integer(cf) - 1L  # R is 1-based
+        }
+        params$categorical_feature <- paste0(cf, collapse = ",")
+      }
+      pstr <- lgb.params2str(params)
+      ref_handle <- NULL
+      if (!is.null(private$reference)) {
+        private$reference$construct()
+        ref_handle <- private$reference$.__enclos_env__$private$handle
+      }
+      data <- private$raw_data
+      if (!is.null(private$used_indices)) {
+        # slice of an already-constructed dataset
+        parent <- private$reference
+        parent$construct()
+        private$handle <- lgb.call(
+          "LGBM_DatasetGetSubset_R",
+          parent$.__enclos_env__$private$handle,
+          as.integer(private$used_indices),
+          length(private$used_indices), pstr,
+          ret = lgb.null.handle())
+      } else if (is.character(data)) {
+        private$handle <- lgb.call(
+          "LGBM_DatasetCreateFromFile_R", path.expand(data), pstr,
+          ref_handle, ret = lgb.null.handle())
+      } else if (inherits(data, "dgCMatrix")) {
+        private$handle <- lgb.call(
+          "LGBM_DatasetCreateFromCSC_R", data@p, data@i, data@x,
+          length(data@p), length(data@x), nrow(data), pstr, ref_handle,
+          ret = lgb.null.handle())
+      } else {
+        data <- as.matrix(data)
+        storage.mode(data) <- "double"
+        private$handle <- lgb.call(
+          "LGBM_DatasetCreateFromMat_R", data, nrow(data), ncol(data),
+          pstr, ref_handle, ret = lgb.null.handle())
+      }
+      if (!is.null(private$colnames_)) {
+        lgb.call("LGBM_DatasetSetFeatureNames_R", private$handle,
+                 paste0(private$colnames_, collapse = "\t"))
+      }
+      for (key in names(private$info)) {
+        self$setinfo(key, private$info[[key]])
+      }
+      if (private$free_raw_data) private$raw_data <- NULL
+      invisible(self)
+    },
+
+    get_handle = function() {
+      self$construct()
+      private$handle
+    },
+
+    dim = function() {
+      self$construct()
+      nd <- lgb.call.return.int("LGBM_DatasetGetNumData_R", private$handle)
+      nf <- lgb.call.return.int("LGBM_DatasetGetNumFeature_R",
+                                private$handle)
+      c(nd, nf)
+    },
+
+    get_colnames = function() {
+      self$construct()
+      joined <- lgb.call.return.str("LGBM_DatasetGetFeatureNames_R",
+                                    private$handle)
+      strsplit(joined, "\n", fixed = TRUE)[[1L]]
+    },
+
+    set_colnames = function(colnames) {
+      private$colnames_ <- colnames
+      if (!is.null(private$handle)) {
+        lgb.call("LGBM_DatasetSetFeatureNames_R", private$handle,
+                 paste0(colnames, collapse = "\t"))
+      }
+      invisible(self)
+    },
+
+    getinfo = function(name) {
+      self$construct()
+      size <- lgb.call.return.int("LGBM_DatasetGetFieldSize_R",
+                                  private$handle, name)
+      if (size == 0L) return(NULL)
+      if (name %in% c("group", "query")) {
+        out <- integer(size)
+      } else {
+        out <- numeric(size)
+      }
+      out <- lgb.call("LGBM_DatasetGetField_R", private$handle, name,
+                      ret = out)
+      if (name %in% c("group", "query")) diff(out) else out
+    },
+
+    setinfo = function(name, info) {
+      if (is.null(info)) return(invisible(self))
+      self$construct()
+      if (name %in% c("group", "query")) {
+        info <- as.integer(info)
+      } else {
+        info <- as.numeric(info)
+      }
+      lgb.call("LGBM_DatasetSetField_R", private$handle, name, info,
+               length(info))
+      private$info[[name]] <- NULL
+      invisible(self)
+    },
+
+    slice = function(idxset, ...) {
+      Dataset$new(data = NULL, params = private$params, reference = self,
+                  colnames = private$colnames_,
+                  categorical_feature = private$categorical_feature,
+                  free_raw_data = private$free_raw_data,
+                  used_indices = idxset, info = list(...))
+    },
+
+    save_binary = function(fname) {
+      self$construct()
+      lgb.call("LGBM_DatasetSaveBinary_R", private$handle,
+               path.expand(fname))
+      invisible(self)
+    },
+
+    set_categorical_feature = function(categorical_feature) {
+      if (!is.null(private$handle)) {
+        stop("set_categorical_feature: dataset already constructed")
+      }
+      private$categorical_feature <- categorical_feature
+      invisible(self)
+    },
+
+    set_reference = function(reference) {
+      if (!is.null(private$handle)) {
+        stop("set_reference: dataset already constructed")
+      }
+      private$reference <- reference
+      invisible(self)
+    },
+
+    update_params = function(params) {
+      private$params <- modifyList(private$params, params)
+      invisible(self)
+    },
+
+    finalize = function() {
+      if (!is.null(private$handle)) {
+        tryCatch(lgb.call("LGBM_DatasetFree_R", private$handle),
+                 error = function(e) NULL)
+        private$handle <- NULL
+      }
+    }
+  ),
+  private = list(
+    raw_data = NULL, params = list(), reference = NULL, colnames_ = NULL,
+    categorical_feature = NULL, free_raw_data = TRUE, used_indices = NULL,
+    info = list(), handle = NULL
+  )
+)
+
+#' Construct an lgb.Dataset from a matrix, dgCMatrix or data file path.
+lgb.Dataset <- function(data, params = list(), reference = NULL,
+                        colnames = NULL, categorical_feature = NULL,
+                        free_raw_data = TRUE, info = list(), ...) {
+  if (is.null(colnames) && !is.null(dimnames(data)[[2L]])) {
+    colnames <- dimnames(data)[[2L]]
+  }
+  Dataset$new(data = data, params = params, reference = reference,
+              colnames = colnames, categorical_feature = categorical_feature,
+              free_raw_data = free_raw_data, info = info, ...)
+}
+
+#' Validation dataset aligned to a training dataset's bin mappers.
+lgb.Dataset.create.valid <- function(dataset, data, info = list(), ...) {
+  if (!lgb.check.r6.class(dataset, "lgb.Dataset")) {
+    stop("lgb.Dataset.create.valid: dataset must be an lgb.Dataset")
+  }
+  lgb.Dataset(data, reference = dataset, info = info, ...)
+}
+
+lgb.Dataset.construct <- function(dataset) {
+  dataset$construct()
+}
+
+lgb.Dataset.save <- function(dataset, fname) {
+  dataset$save_binary(fname)
+}
+
+lgb.Dataset.set.categorical <- function(dataset, categorical_feature) {
+  dataset$set_categorical_feature(categorical_feature)
+}
+
+lgb.Dataset.set.reference <- function(dataset, reference) {
+  dataset$set_reference(reference)
+}
+
+dim.lgb.Dataset <- function(x, ...) {
+  x$dim()
+}
+
+dimnames.lgb.Dataset <- function(x) {
+  list(NULL, x$get_colnames())
+}
+
+`dimnames<-.lgb.Dataset` <- function(x, value) {
+  x$set_colnames(value[[2L]])
+  x
+}
+
+slice <- function(dataset, ...) UseMethod("slice")
+
+slice.lgb.Dataset <- function(dataset, idxset, ...) {
+  dataset$slice(idxset, ...)
+}
+
+getinfo <- function(dataset, ...) UseMethod("getinfo")
+
+getinfo.lgb.Dataset <- function(dataset, name, ...) {
+  dataset$getinfo(name)
+}
+
+setinfo <- function(dataset, ...) UseMethod("setinfo")
+
+setinfo.lgb.Dataset <- function(dataset, name, info, ...) {
+  dataset$setinfo(name, info)
+}

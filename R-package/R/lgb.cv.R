@@ -1,0 +1,117 @@
+# lgb.cv — k-fold cross validation (reference surface:
+# R-package/R/lgb.cv.R: folds, stratified option, per-fold boosters,
+# aggregated mean/sd eval record, early stopping on the aggregate).
+# Our own implementation.
+
+lgb.cv <- function(params = list(), data, nrounds = 100L, nfold = 5L,
+                   label = NULL, obj = NULL, eval = NULL, verbose = 1L,
+                   record = TRUE, eval_freq = 1L, stratified = TRUE,
+                   folds = NULL, early_stopping_rounds = NULL,
+                   callbacks = list(), ...) {
+  params <- modifyList(params, list(...))
+  if (is.character(obj)) {
+    params$objective <- obj
+    obj <- NULL
+  }
+  if (!lgb.check.r6.class(data, "lgb.Dataset")) {
+    stop("lgb.cv: data must be an lgb.Dataset")
+  }
+  if (!is.null(label)) data$setinfo("label", label)
+  data$construct()
+  n <- data$dim()[1L]
+
+  if (is.null(folds)) {
+    y <- data$getinfo("label")
+    folds <- .lgb_make_folds(n, nfold, y, stratified)
+  }
+
+  boosters <- list()
+  for (k in seq_along(folds)) {
+    test_idx <- folds[[k]]
+    train_idx <- setdiff(seq_len(n), test_idx)
+    dtrain <- data$slice(train_idx)
+    dtest <- data$slice(test_idx)
+    dtrain$construct()
+    dtest$construct()
+    bst <- Booster$new(params = params, train_set = dtrain)
+    bst$add_valid(dtest, "valid")
+    boosters[[k]] <- bst
+  }
+
+  cv <- list(record_evals = list(), boosters = boosters,
+             best_iter = -1L, best_score = NA_real_)
+  class(cv) <- "lgb.CVBooster"
+
+  best_score <- NA_real_
+  best_iter <- 0L
+  for (i in seq_len(nrounds)) {
+    evals <- list()
+    for (bst in boosters) {
+      bst$update(fobj = obj)
+      evals[[length(evals) + 1L]] <- bst$eval_valid(feval = eval)
+    }
+    if (length(evals[[1L]]) > 0L) {
+      agg <- list()
+      for (j in seq_along(evals[[1L]])) {
+        vals <- vapply(evals, function(e) e[[j]]$value, numeric(1L))
+        e0 <- evals[[1L]][[j]]
+        agg[[j]] <- list(name = e0$name, mean = mean(vals),
+                         sd = stats::sd(vals),
+                         higher_better = e0$higher_better)
+        if (record) {
+          rec <- cv$record_evals[["valid"]]
+          if (is.null(rec)) rec <- list()
+          if (is.null(rec[[e0$name]])) {
+            rec[[e0$name]] <- list(eval = list(), err = list())
+          }
+          rec[[e0$name]]$eval <- c(rec[[e0$name]]$eval, mean(vals))
+          rec[[e0$name]]$err <- c(rec[[e0$name]]$err, stats::sd(vals))
+          cv$record_evals[["valid"]] <- rec
+        }
+      }
+      if (verbose > 0L && (i - 1L) %% eval_freq == 0L) {
+        msgs <- vapply(agg, function(a) {
+          sprintf("valid %s:%g+%g", a$name, a$mean, a$sd)
+        }, character(1L))
+        cat(sprintf("[%d]\t%s\n", i, paste(msgs, collapse = "\t")))
+      }
+      a0 <- agg[[1L]]
+      better <- is.na(best_score) ||
+        (a0$higher_better && a0$mean > best_score) ||
+        (!a0$higher_better && a0$mean < best_score)
+      if (better) {
+        best_score <- a0$mean
+        best_iter <- i
+      } else if (!is.null(early_stopping_rounds) &&
+                 i - best_iter >= early_stopping_rounds) {
+        if (verbose > 0L) {
+          cat(sprintf("Early stopping, best iteration is %d\n", best_iter))
+        }
+        break
+      }
+    }
+  }
+  cv$best_iter <- best_iter
+  cv$best_score <- best_score
+  cv
+}
+
+# internal: (stratified) fold assignment
+.lgb_make_folds <- function(n, nfold, y = NULL, stratified = TRUE) {
+  if (stratified && !is.null(y) && length(unique(y)) <= 32L) {
+    folds <- vector("list", nfold)
+    for (cls in unique(y)) {
+      idx <- sample(which(y == cls))
+      assign_to <- factor(rep_len(seq_len(nfold), length(idx)),
+                          levels = seq_len(nfold))
+      parts <- split(idx, assign_to)   # always nfold entries
+      for (k in seq_len(nfold)) {
+        folds[[k]] <- c(folds[[k]], parts[[k]])
+      }
+    }
+    lapply(folds, sort)
+  } else {
+    idx <- sample(n)
+    unname(split(idx, rep_len(seq_len(nfold), n)))
+  }
+}

@@ -1,0 +1,77 @@
+# Feature importance + tree table (reference surface:
+# R-package/R/lgb.importance.R and lgb.model.dt.tree.R). Our own
+# implementation parsing the model's JSON dump with jsonlite.
+
+lgb.importance <- function(model, percentage = TRUE) {
+  tree_dt <- lgb.model.dt.tree(model)
+  splits <- tree_dt[!is.na(tree_dt$split_feature), , drop = FALSE]
+  if (nrow(splits) == 0L) {
+    return(data.frame(Feature = character(0), Gain = numeric(0),
+                      Cover = numeric(0), Frequency = numeric(0)))
+  }
+  agg <- stats::aggregate(
+    cbind(Gain = splits$split_gain, Cover = splits$internal_count,
+          Frequency = rep(1, nrow(splits))) ~ split_feature,
+    data = splits, FUN = sum)
+  names(agg)[1L] <- "Feature"
+  if (percentage) {
+    agg$Gain <- agg$Gain / sum(agg$Gain)
+    agg$Cover <- agg$Cover / sum(agg$Cover)
+    agg$Frequency <- agg$Frequency / sum(agg$Frequency)
+  }
+  agg[order(-agg$Gain), , drop = FALSE]
+}
+
+lgb.model.dt.tree <- function(model, num_iteration = -1L) {
+  json <- jsonlite::fromJSON(model$dump_model(num_iteration),
+                             simplifyVector = FALSE)
+  feature_names <- unlist(json$feature_names)
+  rows <- list()
+  walk <- function(node, tree_index, parent = NA_integer_, depth = 0L) {
+    if (!is.null(node$split_feature)) {
+      fid <- as.integer(node$split_feature)
+      rows[[length(rows) + 1L]] <<- data.frame(
+        tree_index = tree_index,
+        depth = depth,
+        split_index = as.integer(node$split_index),
+        split_feature = if (fid + 1L <= length(feature_names))
+          feature_names[fid + 1L] else as.character(fid),
+        split_gain = as.numeric(node$split_gain),
+        threshold = as.numeric(node$threshold),
+        decision_type = as.character(node$decision_type),
+        internal_value = as.numeric(node$internal_value),
+        internal_count = as.numeric(node$internal_count),
+        leaf_index = NA_integer_, leaf_value = NA_real_,
+        leaf_count = NA_real_, stringsAsFactors = FALSE)
+      walk(node$left_child, tree_index, node$split_index, depth + 1L)
+      walk(node$right_child, tree_index, node$split_index, depth + 1L)
+    } else {
+      rows[[length(rows) + 1L]] <<- data.frame(
+        tree_index = tree_index, depth = depth,
+        split_index = NA_integer_, split_feature = NA_character_,
+        split_gain = NA_real_, threshold = NA_real_,
+        decision_type = NA_character_, internal_value = NA_real_,
+        internal_count = NA_real_,
+        leaf_index = as.integer(node$leaf_index),
+        leaf_value = as.numeric(node$leaf_value),
+        leaf_count = as.numeric(node$leaf_count %||% NA),
+        stringsAsFactors = FALSE)
+    }
+  }
+  for (t in seq_along(json$tree_info)) {
+    walk(json$tree_info[[t]]$tree_structure, t - 1L)
+  }
+  do.call(rbind, rows)
+}
+
+`%||%` <- function(a, b) if (is.null(a)) b else a
+
+#' Bar plot of feature importance.
+lgb.plot.importance <- function(tree_imp, top_n = 10L,
+                                measure = "Gain", ...) {
+  imp <- utils::head(tree_imp[order(-tree_imp[[measure]]), ], top_n)
+  graphics::barplot(rev(imp[[measure]]), names.arg = rev(imp$Feature),
+                    horiz = TRUE, las = 1,
+                    main = paste("Feature importance by", measure), ...)
+  invisible(imp)
+}

@@ -1,0 +1,74 @@
+# lgb.train — the main training entry point (reference surface:
+# R-package/R/lgb.train.R). Our own implementation over lgb.Booster.
+
+lgb.train <- function(params = list(), data, nrounds = 100L,
+                      valids = list(), obj = NULL, eval = NULL,
+                      verbose = 1L, record = TRUE, eval_freq = 1L,
+                      init_model = NULL, colnames = NULL,
+                      categorical_feature = NULL,
+                      early_stopping_rounds = NULL, callbacks = list(),
+                      ...) {
+  params <- modifyList(params, list(...))
+  if (is.character(obj)) {
+    params$objective <- obj
+    obj <- NULL
+  } else if (!is.null(params$objective) && is.function(params$objective)) {
+    obj <- params$objective
+    params$objective <- "none"
+  }
+  if (!lgb.check.r6.class(data, "lgb.Dataset")) {
+    stop("lgb.train: data must be an lgb.Dataset")
+  }
+  if (!is.null(colnames)) data$set_colnames(colnames)
+  if (!is.null(categorical_feature)) {
+    data$set_categorical_feature(categorical_feature)
+  }
+  data$construct()
+
+  booster <- if (!is.null(init_model)) {
+    b <- if (is.character(init_model)) Booster$new(modelfile = init_model)
+         else init_model
+    b$reset_training_data(data)  # continue training on this data
+    b
+  } else {
+    Booster$new(params = params, train_set = data)
+  }
+  for (name in names(valids)) {
+    booster$add_valid(valids[[name]], name)
+  }
+
+  if (verbose > 0L && length(valids) > 0L) {
+    callbacks <- c(callbacks, list(cb.print.evaluation(eval_freq)))
+  }
+  if (record) {
+    callbacks <- c(callbacks, list(cb.record.evaluation()))
+  }
+  if (!is.null(early_stopping_rounds) && early_stopping_rounds > 0L) {
+    callbacks <- c(callbacks,
+                   list(cb.early.stop(early_stopping_rounds,
+                                      verbose = verbose > 0L)))
+  }
+  cbs <- .lgb_categorize_callbacks(callbacks)
+
+  env <- new.env()
+  env$booster <- booster
+  env$end_iteration <- nrounds
+  env$met_early_stop <- FALSE
+  start_iter <- booster$current_iter()
+  for (i in seq_len(nrounds)) {
+    env$iteration <- start_iter + i
+    env$eval_list <- list()
+    for (cb in cbs$before) cb(env)
+    booster$update(fobj = obj)
+    if (length(valids) > 0L || !is.null(eval)) {
+      env$eval_list <- c(
+        if (isTRUE(params$is_provide_training_metric))
+          booster$eval_train(feval = eval) else list(),
+        booster$eval_valid(feval = eval))
+    }
+    for (cb in cbs$after) cb(env)
+    if (env$met_early_stop) break
+  }
+  if (booster$best_iter < 0L) booster$best_iter <- booster$current_iter()
+  booster
+}

@@ -1,0 +1,60 @@
+# Top-level convenience API (reference surface: R-package/R/lightgbm.R,
+# saveRDS.lgb.Booster.R, readRDS.lgb.Booster.R).
+
+#' Simple training interface: builds the lgb.Dataset and trains.
+lightgbm <- function(data, label = NULL, weight = NULL, params = list(),
+                     nrounds = 100L, verbose = 1L, objective = "regression",
+                     init_score = NULL, save_name = NULL, ...) {
+  params$objective <- params$objective %||% objective
+  dtrain <- if (lgb.check.r6.class(data, "lgb.Dataset")) data else
+    lgb.Dataset(data, label = label, weight = weight,
+                init_score = init_score)
+  booster <- lgb.train(params = params, data = dtrain, nrounds = nrounds,
+                       verbose = verbose, ...)
+  if (!is.null(save_name)) booster$save_model(save_name)
+  booster
+}
+
+#' Serialize a Booster into an RDS-safe object (handles are process-local;
+#' the model travels as its text form).
+saveRDS.lgb.Booster <- function(object, file, ...) {
+  raw_model <- object$save_model_to_string()
+  saveRDS(list(lgb_booster_model_str = raw_model,
+               best_iter = object$best_iter,
+               record_evals = object$record_evals), file = file, ...)
+}
+
+#' Restore a Booster written by saveRDS.lgb.Booster.
+readRDS.lgb.Booster <- function(file, ...) {
+  obj <- readRDS(file, ...)
+  if (is.null(obj$lgb_booster_model_str)) {
+    stop("readRDS.lgb.Booster: not a saved lgb.Booster")
+  }
+  booster <- Booster$new(model_str = obj$lgb_booster_model_str)
+  booster$best_iter <- obj$best_iter
+  booster$record_evals <- obj$record_evals
+  booster
+}
+
+#' Unload/reload helper (reference: lgb.unloader.R) — frees handles held
+#' by objects in an environment so the shared library can be unloaded.
+lgb.unloader <- function(restore = TRUE, wipe = FALSE,
+                         envir = .GlobalEnv) {
+  if (wipe) {
+    objs <- ls(envir = envir)
+    for (nm in objs) {
+      o <- get(nm, envir = envir)
+      if (lgb.check.r6.class(o, "lgb.Booster") ||
+          lgb.check.r6.class(o, "lgb.Dataset")) {
+        rm(list = nm, envir = envir)
+      }
+    }
+  }
+  gc()
+  try(dyn.unload(getLoadedDLLs()[["lightgbm"]][["path"]]), silent = TRUE)
+  if (restore) {
+    library.dynam("lightgbm", package = "lightgbmtpu",
+                  lib.loc = .libPaths())
+  }
+  invisible(NULL)
+}

@@ -1,12 +1,11 @@
-# R interface to lightgbm_tpu (reference surface: R-package/R/ in
-# LightGBM — lgb.Dataset / lgb.train / predict / lgb.importance).
+# CLI-transport FALLBACK binding (lgb.cli.* namespace).
 #
-# Transport: the framework's CLI (`python -m lightgbm_tpu`) and the
-# LightGBM-compatible text model format. The reference binds in-process
-# through lightgbm_R.cpp over the C API; the equivalent here is
-# native/lib_lightgbm_tpu.so (the LGBM_* C ABI), which .Call glue can
-# target — the CLI transport is used by default because it has no compiled
-# dependency on the R toolchain.
+# The primary binding is the in-process .Call glue (src/lightgbm_tpu_R.c
+# over native/lib_lightgbm_tpu.so) with the R6 surface in lgb.Dataset.R /
+# lgb.Booster.R / lgb.train.R. This file keeps a zero-compile fallback
+# that shells out to `python -m lightgbm_tpu` and round-trips through the
+# text model format — for environments without a C toolchain. Functions
+# are namespaced lgb.cli.* so they never shadow the primary surface.
 
 .lgb_python <- function() {
   py <- Sys.getenv("LGBM_TPU_PYTHON", "python3")
@@ -33,7 +32,7 @@
 
 #' Create a dataset descriptor (data written as TSV with the label in
 #' column 0, the CLI's native layout).
-lgb.Dataset <- function(data, label = NULL, weight = NULL, group = NULL) {
+lgb.cli.Dataset <- function(data, label = NULL, weight = NULL, group = NULL) {
   path <- tempfile(fileext = ".tsv")
   mat <- as.matrix(data)
   if (is.null(label)) label <- rep(0, nrow(mat))
@@ -46,14 +45,14 @@ lgb.Dataset <- function(data, label = NULL, weight = NULL, group = NULL) {
     writeLines(as.character(group), paste0(path, ".query"))
   }
   structure(list(path = path, nrow = nrow(mat), ncol = ncol(mat)),
-            class = "lgb.Dataset")
+            class = "lgb.cli.Dataset")
 }
 
 #' Train a model (reference: lgb.train). `params` is a named list using
 #' LightGBM parameter names; returns an lgb.Booster.
-lgb.train <- function(params = list(), data, nrounds = 100L,
+lgb.cli.train <- function(params = list(), data, nrounds = 100L,
                       valids = list(), verbose = -1L) {
-  stopifnot(inherits(data, "lgb.Dataset"))
+  stopifnot(inherits(data, "lgb.cli.Dataset"))
   model_path <- tempfile(fileext = ".txt")
   args <- c("task=train",
             paste0("data=", data$path),
@@ -70,14 +69,14 @@ lgb.train <- function(params = list(), data, nrounds = 100L,
   .lgb_cli(args)
   booster <- structure(list(model_path = model_path,
                             model_str = readLines(model_path)),
-                       class = "lgb.Booster")
+                       class = "lgb.cli.Booster")
   booster
 }
 
 #' Predict with a trained model (reference: predict.lgb.Booster).
-predict.lgb.Booster <- function(object, data, rawscore = FALSE,
+lgb.cli.predict <- function(object, data, rawscore = FALSE,
                                 predleaf = FALSE, ...) {
-  ds <- if (inherits(data, "lgb.Dataset")) data else lgb.Dataset(data)
+  ds <- if (inherits(data, "lgb.cli.Dataset")) data else lgb.cli.Dataset(data)
   out_path <- tempfile(fileext = ".txt")
   args <- c("task=predict",
             paste0("data=", ds$path),
@@ -93,8 +92,8 @@ predict.lgb.Booster <- function(object, data, rawscore = FALSE,
 
 #' Feature importance parsed from the model text (reference:
 #' lgb.importance over the dumped model).
-lgb.importance <- function(booster) {
-  stopifnot(inherits(booster, "lgb.Booster"))
+lgb.cli.importance <- function(booster) {
+  stopifnot(inherits(booster, "lgb.cli.Booster"))
   lines <- booster$model_str
   feat_line <- grep("^feature_names=", lines, value = TRUE)
   feats <- strsplit(sub("^feature_names=", "", feat_line), " ")[[1]]
@@ -107,12 +106,12 @@ lgb.importance <- function(booster) {
 }
 
 #' Save / load the LightGBM-compatible text model.
-lgb.save <- function(booster, filename) {
+lgb.cli.save <- function(booster, filename) {
   writeLines(booster$model_str, filename)
   invisible(booster)
 }
 
-lgb.load <- function(filename) {
+lgb.cli.load <- function(filename) {
   structure(list(model_path = filename, model_str = readLines(filename)),
-            class = "lgb.Booster")
+            class = "lgb.cli.Booster")
 }

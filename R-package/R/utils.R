@@ -1,0 +1,78 @@
+# Internal helpers shared by the lightgbm_tpu R package.
+#
+# Reference surface: R-package/R/utils.R (lgb.call / lgb.params2str /
+# handle checks). Implementation is our own over the .Call glue in
+# src/lightgbm_tpu_R.c; error reporting follows the same call_state +
+# LGBM_GetLastError_R contract so either binding loads.
+
+lgb.null.handle <- function() {
+  methods::new("externalptr")
+}
+
+lgb.last.error <- function() {
+  # out-arguments must be RUNTIME allocations: byte-compiled R dedupes
+  # literal constants, so a C write into a passed literal (e.g. 0L)
+  # would corrupt every other use of that constant in the function
+  act_len <- integer(1L)
+  msg <- .Call("LGBM_GetLastError_R", 4096L, act_len, character(1L),
+               PACKAGE = "lightgbmtpu")
+  stop("lightgbm_tpu: ", msg, call. = FALSE)
+}
+
+# run a .Call glue entry point with the trailing call_state flag and
+# re-raise through LGBM_GetLastError on failure. call_state is a fresh
+# allocation per call (see lgb.last.error note).
+lgb.call <- function(fun_name, ..., ret = NULL) {
+  call_state <- integer(1L)
+  if (!is.null(ret)) {
+    ret <- .Call(fun_name, ..., ret, call_state, PACKAGE = "lightgbmtpu")
+  } else {
+    ret <- .Call(fun_name, ..., call_state, PACKAGE = "lightgbmtpu")
+  }
+  if (call_state[1L] != 0L) lgb.last.error()
+  ret
+}
+
+# glue string-out entry points RETURN a freshly allocated character
+# vector; the placeholder argument only keeps reference arity
+lgb.call.return.str <- function(fun_name, ...) {
+  act_len <- integer(1L)
+  buf_len <- 1024L * 1024L
+  buf <- lgb.call(fun_name, ..., buf_len, act_len, ret = character(1L))
+  if (act_len[1L] > buf_len) {
+    buf_len <- act_len[1L]
+    buf <- lgb.call(fun_name, ..., buf_len, act_len, ret = character(1L))
+  }
+  buf
+}
+
+# glue scalar-out entry points RETURN the scalar; the placeholder
+# argument keeps reference arity
+lgb.call.return.int <- function(fun_name, ...) {
+  lgb.call(fun_name, ..., ret = integer(1L))
+}
+
+lgb.params2str <- function(params, ...) {
+  if (!identical(class(params), "list")) {
+    stop("params must be a list")
+  }
+  extra <- list(...)
+  params <- modifyList(params, extra)
+  pairs <- character(0)
+  for (key in names(params)) {
+    val <- params[[key]]
+    if (is.null(val) || length(val) == 0L) next
+    val <- paste0(as.character(unlist(val)), collapse = ",")
+    pairs <- c(pairs, paste0(key, "=", val))
+  }
+  paste0(pairs, collapse = " ")
+}
+
+lgb.check.r6.class <- function(object, name) {
+  all(c("R6", name) %in% class(object))
+}
+
+# the metrics where smaller is better (mirrors metric registry defaults)
+.lgb_higher_better <- function(name) {
+  grepl("auc|ndcg|map|acc", name)
+}

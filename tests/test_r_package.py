@@ -1,0 +1,103 @@
+"""R package verification without an R toolchain (none in this image):
+
+1. the .Call glue (R-package/src/lightgbm_tpu_R.c) smoke-compiles with
+   plain cc using its fallback R-API declarations;
+2. its exported entry points match the REFERENCE's lightgbm_R.h list —
+   same 38 names, same arity — so R code written against either binding
+   loads (VERDICT r2 item 7's symbol-parity gate);
+3. every LGBM_* C-ABI function the glue links against actually exists in
+   lib_lightgbm_tpu.so;
+4. every .Call target in the R sources is a registered glue entry point.
+"""
+import os
+import re
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GLUE = os.path.join(REPO, "R-package", "src", "lightgbm_tpu_R.c")
+REF_HEADER = "/root/reference/include/LightGBM/lightgbm_R.h"
+R_DIR = os.path.join(REPO, "R-package", "R")
+
+
+def _ref_prototypes():
+    if not os.path.exists(REF_HEADER):
+        pytest.skip("reference lightgbm_R.h not present")
+    text = open(REF_HEADER).read()
+    protos = {}
+    for m in re.finditer(r"(LGBM_\w+_R)\(([^;]*?)\);", text, re.S):
+        args = [a for a in m.group(2).split(",") if a.strip()]
+        protos[m.group(1)] = len(args)
+    return protos
+
+
+def test_glue_smoke_compiles():
+    out = subprocess.run(
+        ["cc", "-c", "-Wall", "-Werror", "-o", "/dev/null", GLUE],
+        capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr
+
+
+def test_glue_symbol_and_arity_parity_with_reference():
+    protos = _ref_prototypes()
+    assert len(protos) >= 38
+    glue = open(GLUE).read()
+    # definitions present
+    for name in protos:
+        assert re.search(rf"SEXP {name}\(", glue), f"missing glue: {name}"
+    # registration table arities match the reference prototypes
+    calldefs = dict(re.findall(r"CALLDEF\((LGBM_\w+_R), (\d+)\)", glue))
+    for name, nargs in protos.items():
+        assert name in calldefs, f"not registered: {name}"
+        assert int(calldefs[name]) == nargs, \
+            f"{name}: glue arity {calldefs[name]} != reference {nargs}"
+
+
+def test_glue_c_abi_symbols_exist_in_library():
+    so = os.path.join(REPO, "native", "lib_lightgbm_tpu.so")
+    if not os.path.exists(so):
+        import sys
+        subprocess.run([sys.executable,
+                        os.path.join(REPO, "native", "build.py")],
+                       check=True, capture_output=True, timeout=120)
+    nm = subprocess.run(["nm", "-D", so], capture_output=True, text=True)
+    exported = set(re.findall(r"T (LGBM_\w+)", nm.stdout))
+    glue = open(GLUE).read()
+    used = set(re.findall(r"\b(LGBM_\w+)\(", glue))
+    used = {u for u in used if not u.endswith("_R")}
+    missing = used - exported
+    assert not missing, f"glue links missing C-ABI symbols: {missing}"
+
+
+def test_r_sources_call_only_registered_entry_points():
+    glue = open(GLUE).read()
+    registered = set(re.findall(r"CALLDEF\((LGBM_\w+_R),", glue))
+    for fname in os.listdir(R_DIR):
+        if not fname.endswith(".R"):
+            continue
+        src = open(os.path.join(R_DIR, fname)).read()
+        for name in re.findall(r'\.Call\("(\w+)"', src):
+            assert name in registered, f"{fname}: unregistered .Call {name}"
+        for name in re.findall(r'lgb\.call(?:\.return\.str)?\("(\w+)"', src):
+            assert name in registered, \
+                f"{fname}: unregistered lgb.call {name}"
+
+
+def test_r_surface_files_present():
+    expected = ["utils.R", "lgb.Dataset.R", "lgb.Booster.R", "callback.R",
+                "lgb.train.R", "lgb.cv.R", "lgb.importance.R",
+                "lightgbm.R", "lightgbm_tpu.R"]
+    for fname in expected:
+        path = os.path.join(R_DIR, fname)
+        assert os.path.exists(path), f"missing R source {fname}"
+    # the core surface functions are defined somewhere in the package
+    allsrc = "".join(open(os.path.join(R_DIR, f)).read()
+                     for f in os.listdir(R_DIR) if f.endswith(".R"))
+    for fn in ["lgb.Dataset <-", "lgb.Dataset.create.valid <-",
+               "lgb.train <-", "lgb.cv <-", "lightgbm <-",
+               "predict.lgb.Booster <-", "lgb.load <-", "lgb.save <-",
+               "lgb.importance <-", "lgb.model.dt.tree <-",
+               "cb.early.stop <-", "saveRDS.lgb.Booster <-",
+               "readRDS.lgb.Booster <-"]:
+        assert fn in allsrc, f"missing R function {fn}"
