@@ -199,12 +199,20 @@ class Dataset:
             reference=ref_inner, keep_raw=not self.free_raw_data,
             # EFB (dataset.cpp:66-211); feature-parallel shards features
             # 1:1 onto stored columns, so bundling is disabled there
+            # (warned below — sparse data keeps its full dense width)
             enable_bundle=(_parse_value(params.get("enable_bundle", True), bool)
                            and params.get("tree_learner", "serial") != "feature"),
             max_conflict_rate=float(params.get("max_conflict_rate", 0.0)),
             sparse_threshold=float(params.get("sparse_threshold", 0.8)),
             mappers=self._preset_mappers)
         self._constructed_max_bin = max_bin
+        if (params.get("tree_learner", "serial") == "feature"
+                and _parse_value(params.get("enable_bundle", True), bool)):
+            log.warning(
+                "tree_learner=feature stores features UNBUNDLED (EFB "
+                "disabled): sparse/high-dimensional data keeps its full "
+                "dense column width. Prefer tree_learner=data for sparse "
+                "data, or set enable_bundle=false to silence this.")
         return self._inner
 
     def construct(self) -> "Dataset":

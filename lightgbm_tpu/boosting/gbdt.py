@@ -580,15 +580,28 @@ class GBDT:
 
     # ------------------------------------------------------------------
     # prediction (reference: gbdt_prediction.cpp + Predictor)
+
+    # rows per device dispatch: large forests (100+ trees) over >=500k-row
+    # batches reproducibly fault the relay-attached TPU worker; chunking
+    # bounds the per-dispatch working set with negligible overhead
+    _PREDICT_ROW_CHUNK = 1 << 17
+
     def _predict_raw_matrix(self, data: np.ndarray,
                             num_iteration: int = -1,
                             pred_early_stop: bool = False,
                             pred_early_stop_freq: int = 10,
                             pred_early_stop_margin: float = 10.0) -> np.ndarray:
         """Raw scores [num_data, num_tree_per_iteration] from raw features."""
+        data = np.asarray(data, np.float32)
+        if data.shape[0] > self._PREDICT_ROW_CHUNK:
+            c = self._PREDICT_ROW_CHUNK
+            return np.concatenate(
+                [self._predict_raw_matrix(
+                    data[i:i + c], num_iteration, pred_early_stop,
+                    pred_early_stop_freq, pred_early_stop_margin)
+                 for i in range(0, data.shape[0], c)], axis=0)
         import jax
         import jax.numpy as jnp
-        data = np.asarray(data, np.float32)
         n = data.shape[0]
         k = self.num_tree_per_iteration
         total = len(self.models)

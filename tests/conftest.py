@@ -9,14 +9,19 @@ value itself before any backend is initialized.
 """
 import os
 
-xla_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in xla_flags:
-    os.environ["XLA_FLAGS"] = (
-        xla_flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
+# LGBM_TPU_TEST_PLATFORM=tpu keeps the real accelerator (used by the
+# opt-in LGBM_TPU_SLOW_TESTS accuracy-floor runs, which would take hours
+# on the CPU backend); everything else runs on the virtual CPU mesh.
+if os.environ.get("LGBM_TPU_TEST_PLATFORM", "cpu") == "cpu":
+    xla_flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in xla_flags:
+        os.environ["XLA_FLAGS"] = (
+            xla_flags + " --xla_force_host_platform_device_count=8").strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
 
-import jax  # noqa: E402
+    import jax
 
-jax.config.update("jax_platforms", "cpu")
-assert jax.devices()[0].platform == "cpu", "tests must run on the CPU backend"
-assert len(jax.devices()) == 8, "tests expect 8 virtual CPU devices"
+    jax.config.update("jax_platforms", "cpu")
+    assert jax.devices()[0].platform == "cpu", \
+        "tests must run on the CPU backend"
+    assert len(jax.devices()) == 8, "tests expect 8 virtual CPU devices"
