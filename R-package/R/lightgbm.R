@@ -51,9 +51,9 @@ lgb.unloader <- function(restore = TRUE, wipe = FALSE,
     }
   }
   gc()
-  try(dyn.unload(getLoadedDLLs()[["lightgbm"]][["path"]]), silent = TRUE)
+  try(dyn.unload(getLoadedDLLs()[["lightgbmtpu"]][["path"]]), silent = TRUE)
   if (restore) {
-    library.dynam("lightgbm", package = "lightgbmtpu",
+    library.dynam("lightgbmtpu", package = "lightgbmtpu",
                   lib.loc = .libPaths())
   }
   invisible(NULL)

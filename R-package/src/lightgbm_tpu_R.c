@@ -17,6 +17,7 @@
 #include <stdlib.h>
 #include <string.h>
 #include <stdio.h>
+#include <limits.h>
 
 #if defined(__has_include)
 #  if __has_include(<Rinternals.h>)
@@ -563,6 +564,12 @@ SEXP LGBM_BoosterGetNumPredict_R(SEXP handle, SEXP data_idx, SEXP out,
   CHECK_CALL(LGBM_BoosterGetNumPredict(lgbmr_handle(handle),
                                        Rf_asInteger(data_idx), &n),
              call_state);
+  if (n > INT_MAX) {
+    /* INTEGER() cannot hold it; a silent wrap would make the R side
+     * allocate a wrong-sized buffer for the subsequent GetPredict. */
+    Rf_error("prediction count %lld exceeds R integer range",
+             (long long)n);
+  }
   INTEGER(out)[0] = (int)n;
   return Rf_ScalarInteger((int)n);
 }

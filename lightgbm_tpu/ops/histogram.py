@@ -90,6 +90,13 @@ def leaf_histogram(binned: jnp.ndarray, weights: jnp.ndarray,
                loader pads as a suffix) and their chunks are skipped by a
                dynamic trip count — row-count buckets can then share one
                compiled signature with ~zero cost for the padding.
+
+    CONTRACT: padding rows must carry all-zero `weights` channels. n_valid
+    only skips WHOLE trailing chunks; the partial boundary chunk (and the
+    n_chunks==1 fast path, which ignores n_valid entirely) still contract
+    every row, so correctness relies on padded rows contributing zero to
+    every (g, h, cnt) channel — not on the chunk-skip.
+
     Returns: [F, B, 3] float32.
     """
     n, f = binned.shape
