@@ -41,6 +41,11 @@ def _jit_forest_binned(stacked, binned):
     return jax.jit(predict_forest_binned)(stacked, binned)
 
 
+def _pallas_available() -> bool:
+    from ..ops import hist_pallas
+    return hist_pallas.available()
+
+
 def _pad_to(arr: np.ndarray, n: int, value=0):
     pad = n - arr.shape[0]
     if pad <= 0:
@@ -107,6 +112,81 @@ def _grow_and_update(score, binned, grad, hess, row_weight, fmask,
 _grow_and_update_jit = None
 
 
+def _grow_and_update_multi_impl(score, binned, grads, hesses, row_weight,
+                                fmasks, shrinkage, n_valid, fmeta_args, cfg):
+    """Grow ALL num_class trees of one boosting iteration in ONE device
+    program (vmap over the class axis) and update every score row.
+
+    The reference grows class trees sequentially (gbdt.cpp:410-462,
+    one `tree_learner_->Train` per class). SURVEY.md §2.5 marks this the
+    EP-analogue free win on TPU: the class trees of an iteration are
+    independent given the gradients, so vmap fuses their histogram
+    passes into wider contractions and collapses k dispatches + k
+    compiled signatures into one."""
+    import jax
+    import jax.numpy as jnp
+
+    def one(g, h, m):
+        return grow_tree(binned, g, h, row_weight, m, *fmeta_args,
+                         cfg, n_valid=n_valid)
+
+    state = jax.vmap(one)(grads, hesses, fmasks)
+
+    def upd(lv, lid, grew):
+        vals = lv * shrinkage
+        return jnp.where(grew,
+                         vals[jnp.clip(lid, 0, cfg.num_leaves - 1)], 0.0)
+
+    delta = jax.vmap(upd)(state.leaf_value, state.leaf_id,
+                          state.num_leaves_used > 1)
+    small = {k: getattr(state, k) for k in _SMALL_STATE_KEYS}
+    return score + delta, small
+
+
+def _grow_and_update_multi(score, binned, grads, hesses, row_weight, fmasks,
+                           shrinkage, n_valid, fmeta_args, cfg):
+    import jax
+    import jax.numpy as jnp
+    global _grow_and_update_multi_jit
+    if _grow_and_update_multi_jit is None:
+        _grow_and_update_multi_jit = jax.jit(
+            _grow_and_update_multi_impl, static_argnames=("cfg",))
+    return _grow_and_update_multi_jit(score, binned, grads, hesses,
+                                      row_weight, fmasks,
+                                      jnp.float32(shrinkage),
+                                      jnp.int32(n_valid),
+                                      tuple(fmeta_args), cfg=cfg)
+
+
+_grow_and_update_multi_jit = None
+
+
+def _bagging_mask_impl(ridx, *, seed, n, n_pad, fraction):
+    import jax
+    import jax.numpy as jnp
+    key = jax.random.fold_in(jax.random.PRNGKey(seed), ridx)
+    u = jax.random.uniform(key, (n_pad,))
+    real = jnp.arange(n_pad, dtype=jnp.int32) < n
+    return jnp.where(real & (u < fraction), 1.0, 0.0).astype(jnp.float32)
+
+
+_bagging_mask_jit = None
+
+
+def _bagging_mask_device(seed: int, refresh_idx, n: int, n_pad: int,
+                         fraction: float):
+    """[n_pad] f32 in-bag mask on device (no host RNG / H2D transfer)."""
+    import jax
+    import jax.numpy as jnp
+    global _bagging_mask_jit
+    if _bagging_mask_jit is None:
+        _bagging_mask_jit = jax.jit(
+            _bagging_mask_impl,
+            static_argnames=("n", "n_pad", "fraction", "seed"))
+    return _bagging_mask_jit(jnp.int32(refresh_idx), seed=seed, n=n,
+                             n_pad=n_pad, fraction=float(fraction))
+
+
 class GBDT:
     """Reference: class GBDT, gbdt.h:25-441."""
 
@@ -170,11 +250,16 @@ class GBDT:
         local_dev = max(1, ndev // nproc)
 
         chunk = min(self.config.tree.tpu_hist_chunk, 1 << 20)
-        # bound the histogram pass working set (one-hot is [chunk, G, B]):
-        # cap chunk so chunk*G*B stays within a fused-friendly budget
+        # The histogram kernels tile the GROUP axis into blocks of
+        # budget/(chunk*B) groups each (ops/histogram.plan_group_blocks),
+        # so the row chunk no longer shrinks with G*B (the round-3 scheme
+        # collapsed to 512-row chunks at Epsilon-like G*B ~ 128k). Cap the
+        # chunk only enough to keep the unrolled block count ~<= 16 per
+        # pass, with a 4096-row floor so huge G*B widens the plan instead
+        # of re-shrinking the chunk.
         gb = max(1, train_data.num_groups * train_data.max_num_bin())
-        ws_cap = max(256, 1 << int(np.floor(np.log2(max(1, (1 << 26) // gb)))))
-        chunk = min(chunk, ws_cap)
+        target = max(1, (16 << 26) // gb)
+        chunk = min(chunk, max(4096, 1 << int(np.floor(np.log2(target)))))
         self._chunk = int(min(chunk, max(256, 1 << int(np.ceil(np.log2(max(n, 1)))))))
         row_multiple = self._chunk * (local_dev if nproc > 1 else ndev) \
             if self._tree_learner_kind in ("data", "voting") else self._chunk
@@ -270,6 +355,14 @@ class GBDT:
             min_data_in_leaf=self.config.tree.min_data_in_leaf,
             min_sum_hessian_in_leaf=self.config.tree.min_sum_hessian_in_leaf,
             max_depth=self.config.tree.max_depth,
+            group_widths=tuple(
+                int(b) for b in (train_data.groups.group_num_bin
+                                 if train_data.groups is not None
+                                 and train_data.groups.num_groups
+                                 else train_data.num_bins_per_feature())),
+            use_pallas=(self.config.tree.tpu_hist_pallas
+                        and self._tree_learner_kind == "serial"
+                        and _pallas_available()),
         )
 
         # build the distributed grower + finalize the (possibly feature-
@@ -306,7 +399,6 @@ class GBDT:
         self._fmeta = {k: jnp.asarray(v) for k, v in fm.items()}
 
         self._feature_rng = np.random.RandomState(self.config.tree.feature_fraction_seed)
-        self._bagging_rng = np.random.RandomState(self.config.boosting.bagging_seed)
 
         # boost from average (gbdt.cpp:358-378): the score bump happens at
         # init; the bias itself is folded into the first trained tree via
@@ -362,21 +454,33 @@ class GBDT:
         self._valid_score.append(vs + acc)
 
     # ------------------------------------------------------------------
-    def _bagging_weights(self, iter_idx: int, grad=None, hess=None) -> np.ndarray:
-        """0/1 in-bag weights (reference: GBDT::Bagging, gbdt.cpp:225-286).
-        GOSS overrides this using the gradient magnitudes (goss.hpp:87-131)."""
+    def _bagging_weights(self, iter_idx: int, grad=None, hess=None):
+        """0/1 in-bag weights (reference: GBDT::Bagging, gbdt.cpp:225-286),
+        built ON DEVICE: per-row Bernoulli(bagging_fraction) from the jax
+        PRNG keyed by (bagging_seed, refresh index) — the reference's
+        per-block `rand < fraction` scheme without the per-iteration [N]
+        host->device upload. GOSS overrides this using the gradient
+        magnitudes (goss.hpp:87-131). Returns a [n_pad] device array
+        (padding suffix zeroed) or None for no bagging."""
         bf = self.config.boosting.bagging_fraction
         freq = self.config.boosting.bagging_freq
-        n = self._n
         if bf >= 1.0 or freq <= 0:
             return None
         if iter_idx % freq == 0 or not hasattr(self, "_bag_cache"):
-            take = int(n * bf)
-            idx = self._bagging_rng.choice(n, size=take, replace=False)
-            w = np.zeros(n, np.float32)
-            w[idx] = 1.0
-            self._bag_cache = w
+            self._bag_cache = _bagging_mask_device(
+                self.config.boosting.bagging_seed, iter_idx // freq,
+                self._n, self._n_pad, bf)
         return self._bag_cache
+
+    def _row_weight_from_bag(self, bag):
+        """Normalize a bagging result (None / host [n] / device [n_pad])
+        to the [n_pad] device row-weight the grower consumes."""
+        import jax.numpy as jnp
+        if bag is None:
+            return self._base_weight
+        if isinstance(bag, np.ndarray):
+            return jnp.asarray(_pad_to(bag, self._n_pad))
+        return bag
 
     def _feature_mask(self) -> np.ndarray:
         """Per-tree feature_fraction sample (serial_tree_learner.cpp:239-257)."""
@@ -450,12 +554,15 @@ class GBDT:
 
         with tracing.phase("boosting/bagging"):
             bag = self._bagging_weights(self.iter_, grad, hess)
-            row_weight = self._base_weight if bag is None else \
-                jnp.asarray(_pad_to(bag, n_pad))
+            row_weight = self._row_weight_from_bag(bag)
 
         import jax
 
         from ..learner.grow import FMETA_KEYS
+
+        if k > 1 and self._dist_grower is None:
+            return self._train_one_iter_multi(grad, hess, row_weight)
+
         could_split_any = False
         for cls in range(k):
             mask = self._feature_mask()
@@ -503,13 +610,7 @@ class GBDT:
 
             if tree.num_leaves > 1:
                 could_split_any = True
-                with tracing.phase("boosting/update_valid_score"):
-                    dtree = tree.to_device() if self.valid_sets else None
-                    for vi in range(len(self.valid_sets)):
-                        self._valid_score[vi] = \
-                            self._valid_score[vi].at[cls].add(
-                                predict_value_binned(
-                                    dtree, self._valid_binned[vi]))
+                self._update_valid_scores(cls, tree)
                 # fold boost-from-average into the tree AFTER the score
                 # update (scores were bumped at init): gbdt.cpp:445-447
                 if abs(getattr(self, "_pending_bias", 0.0)) > _K_EPSILON:
@@ -518,17 +619,61 @@ class GBDT:
                     self.init_score_bias = 0.0
             self.models.append(tree)
 
+        return self._finish_iter(could_split_any)
+
+    def _update_valid_scores(self, cls: int, tree) -> None:
+        from .. import tracing
+        with tracing.phase("boosting/update_valid_score"):
+            dtree = tree.to_device() if self.valid_sets else None
+            for vi in range(len(self.valid_sets)):
+                self._valid_score[vi] = \
+                    self._valid_score[vi].at[cls].add(
+                        predict_value_binned(
+                            dtree, self._valid_binned[vi]))
+
+    def _finish_iter(self, could_split_any: bool) -> bool:
+        """Advance the iteration counter, rolling the whole iteration
+        back when no class tree could split (gbdt.cpp:466-472)."""
         self.iter_ += 1
         if not could_split_any:
-            # reference: "Stopped training because there are no more leaves
-            # that meet the split requirements" (gbdt.cpp:466-472)
-            for _ in range(k):
+            for _ in range(self.num_tree_per_iteration):
                 self.models.pop()
             self.iter_ -= 1
             log.warning("Stopped training because there are no more leaves "
                         "that meet the split requirements")
             return True
         return False
+
+    def _train_one_iter_multi(self, grad, hess, row_weight) -> bool:
+        """All num_class trees of one iteration as ONE device program
+        (serial learner; see _grow_and_update_multi_impl)."""
+        import jax
+        import jax.numpy as jnp
+
+        from .. import tracing
+        from ..learner.grow import FMETA_KEYS
+
+        k = self.num_tree_per_iteration
+        masks = np.stack([self._feature_mask() for _ in range(k)])
+        with tracing.phase("tree/grow"):
+            self._score, small = _grow_and_update_multi(
+                self._score, self._binned, grad, hess, row_weight,
+                jnp.asarray(masks), self.shrinkage_rate, self._n,
+                [self._fmeta[key] for key in FMETA_KEYS],
+                self._grower_cfg)
+        with tracing.phase("tree/extract"):
+            host = jax.device_get(small)
+        could_split_any = False
+        for cls in range(k):
+            host_state = _HostState({key: v[cls] for key, v in host.items()})
+            tree = Tree.from_grower_state(host_state, self.train_data)
+            if tree.num_leaves > 1:
+                could_split_any = True
+                tree.apply_shrinkage(self.shrinkage_rate)
+                self._update_valid_scores(cls, tree)
+            self.models.append(tree)
+
+        return self._finish_iter(could_split_any)
 
     def rollback_one_iter(self) -> None:
         """Reference: GBDT::RollbackOneIter, gbdt.cpp:476-492."""
@@ -648,17 +793,17 @@ class GBDT:
                 pred_early_stop_margin: float = 10.0) -> np.ndarray:
         import jax.numpy as jnp
         if pred_leaf:
-            from ..ops.predict import predict_leaf_raw
+            from ..ops.predict import predict_forest_leaf_raw, stack_trees_raw
             data = np.asarray(data, np.float32)
             k = self.num_tree_per_iteration
             total = len(self.models)
             if num_iteration > 0:
                 total = min(total, num_iteration * k)
-            dj = jnp.asarray(data)
-            leaves = [np.asarray(predict_leaf_raw(self.models[i].to_device_raw(), dj))
-                      for i in range(total)]
-            return np.stack(leaves, axis=1) if leaves else \
-                np.zeros((data.shape[0], 0), np.int32)
+            if total == 0:
+                return np.zeros((data.shape[0], 0), np.int32)
+            stacked = stack_trees_raw(self.models[:total])
+            return np.asarray(predict_forest_leaf_raw(
+                stacked, jnp.asarray(data)))
         if pred_contrib:
             from ..shap import predict_contrib
             return predict_contrib(self, np.asarray(data, np.float64), num_iteration)

@@ -9,8 +9,6 @@ per-row weight channel fed to the histogram kernel.
 """
 from __future__ import annotations
 
-import numpy as np
-
 from .. import log
 from .gbdt import GBDT
 
@@ -23,30 +21,62 @@ class GOSS(GBDT):
         if config.boosting.bagging_freq > 0 and config.boosting.bagging_fraction != 1.0:
             log.fatal("Cannot use bagging in GOSS")
         log.info("Using GOSS")
-        self._goss_rng = np.random.RandomState(config.boosting.bagging_seed)
 
     def model_name(self) -> str:
         return "goss"
 
     def _bagging_weights(self, iter_idx, grad=None, hess=None):
+        """GOSS row weights built ON DEVICE (no per-iteration [N]
+        argsort on host / H2D upload): the top_rate threshold comes from
+        a device sort of |grad*hess| (the partial-selection analogue of
+        the reference's ArgMaxAtK, array_args.h), and the "other" rows
+        are Bernoulli-sampled at other_k/(n-top_k) with the jax PRNG —
+        the reference's own per-block `rand < prob` scheme
+        (goss.hpp:87-131) rather than exact without-replacement draws."""
         cfg = self.config.boosting
         n = self._n
         # no subsampling for the first 1/lr iterations (goss.hpp:137)
         if iter_idx < int(1.0 / max(cfg.learning_rate, 1e-12)) or grad is None:
             return None
-        g = np.asarray(grad, np.float64).reshape(self.num_tree_per_iteration, -1)[:, :n]
-        h = np.asarray(hess, np.float64).reshape(self.num_tree_per_iteration, -1)[:, :n]
-        mag = np.abs(g * h).sum(axis=0)
         top_k = max(1, int(n * cfg.top_rate))
         other_k = max(1, int(n * cfg.other_rate))
-        order = np.argsort(-mag, kind="stable")
-        top_idx = order[:top_k]
-        rest_idx = order[top_k:]
-        multiply = (n - top_k) / other_k
-        w = np.zeros(n, np.float32)
-        w[top_idx] = 1.0
-        if len(rest_idx) > 0:
-            sampled = self._goss_rng.choice(
-                rest_idx, size=min(other_k, len(rest_idx)), replace=False)
-            w[sampled] = multiply
-        return w
+        return _goss_weights_device(
+            grad, hess, cfg.bagging_seed, iter_idx,
+            self.num_tree_per_iteration, n, self._n_pad, top_k, other_k)
+
+
+def _goss_impl(g, h, it, *, seed, k, n, n_pad, top_k, other_k):
+    import jax
+    import jax.numpy as jnp
+
+    # per-class |g*h| summed over classes (goss.hpp:91 accumulates
+    # fabs(grad*hess) per class — abs BEFORE the class sum, so
+    # opposite-signed class gradients don't cancel a row's magnitude)
+    mag = jnp.abs(g.reshape(k, n_pad) * h.reshape(k, n_pad)).sum(axis=0)
+    real = jnp.arange(n_pad, dtype=jnp.int32) < n
+    mag = jnp.where(real, mag, -jnp.inf)
+    # threshold = top_k-th largest magnitude
+    thresh = -jnp.sort(-mag)[top_k - 1]
+    is_top = mag >= thresh
+    key = jax.random.fold_in(jax.random.PRNGKey(seed), it)
+    u = jax.random.uniform(key, (n_pad,))
+    rest_p = other_k / max(1, n - top_k)
+    multiply = (n - top_k) / other_k
+    w = jnp.where(is_top, 1.0,
+                  jnp.where(u < rest_p, multiply, 0.0))
+    return jnp.where(real, w, 0.0).astype(jnp.float32)
+
+
+_goss_jit = None
+
+
+def _goss_weights_device(grad, hess, seed, iter_idx, k, n, n_pad,
+                         top_k, other_k):
+    import jax
+    import jax.numpy as jnp
+    global _goss_jit
+    if _goss_jit is None:
+        _goss_jit = jax.jit(_goss_impl, static_argnames=(
+            "seed", "k", "n", "n_pad", "top_k", "other_k"))
+    return _goss_jit(grad, hess, jnp.int32(iter_idx), seed=seed, k=k, n=n,
+                     n_pad=n_pad, top_k=top_k, other_k=other_k)

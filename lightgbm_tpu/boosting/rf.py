@@ -56,8 +56,7 @@ class RF(GBDT):
         hess = self._rf_hess.reshape(k, n_pad)
 
         bag = self._bagging_weights(self.iter_, grad, hess)
-        row_weight = self._base_weight if bag is None else \
-            jnp.asarray(np.pad(bag, (0, n_pad - self._n)))
+        row_weight = self._row_weight_from_bag(bag)
 
         from ..tree import Tree
         from ..ops.predict import predict_value_binned

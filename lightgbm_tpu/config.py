@@ -173,6 +173,12 @@ class TreeConfig:
     tpu_batch_k: int = 12
     # bf16 hi+lo MXU histogram contraction (ops/histogram.py)
     tpu_hist_bf16: bool = True
+    # opt-in fused pallas histogram kernel (ops/hist_pallas.py). Off by
+    # default: measured on v5e, XLA's own fusion of the one-hot compare
+    # into the dot already matches it (11.1 vs 14.4 ms/pass at 2M x 28
+    # x 64 x 24-leaves), so the portable path wins until the kernel
+    # exploits sub-32-bit compares (blocked on Mosaic layout support).
+    tpu_hist_pallas: bool = False
 
 
 @dataclass

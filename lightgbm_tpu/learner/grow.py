@@ -113,6 +113,15 @@ class GrowerConfig(NamedTuple):
     voting: bool = False
     top_k: int = 20
     num_data_shards: int = 1
+    # static per-STORED-GROUP bin counts; the histogram kernels tile the
+    # group axis into constant-row-chunk blocks scanned at each block's
+    # own width (ops/histogram.plan_group_blocks). () = uniform max_bins.
+    # Ignored under feature parallelism (each shard sees a traced feature
+    # offset, so a static per-shard plan is impossible there).
+    group_widths: tuple = ()
+    # fused pallas histogram kernel (ops/hist_pallas.py) — TPU serial
+    # learner only; the GBDT layer sets this from backend + config
+    use_pallas: bool = False
 
 
 class TreeGrowerState(NamedTuple):
@@ -487,10 +496,25 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     # the round loop)
     binned_T = binned.T
 
+    gw = cfg.group_widths \
+        if (cfg.feature_axis is None
+            and len(cfg.group_widths) == local_binned.shape[1]) else None
+    # fused pallas kernels: serial bf16 path only (the distributed
+    # learners keep the portable XLA kernels under shard_map)
+    pallas_on = (cfg.use_pallas and cfg.hist_bf16
+                 and cfg.data_axis is None and cfg.feature_axis is None)
+
     # --- root (BeforeTrain: serial_tree_learner.cpp:234-323) ------------
-    root_hist = reduce_hist(
-        hist_ops.leaf_histogram(local_binned, w3, B, cfg.chunk,
-                                bf16=cfg.hist_bf16, n_valid=nv_local))
+    if pallas_on:
+        from ..ops import hist_pallas
+        root_hist = hist_pallas.leaf_histogram_tpu(
+            binned_T, w3, B, cfg.chunk, n_valid=nv_local,
+            group_widths=gw)
+    else:
+        root_hist = reduce_hist(
+            hist_ops.leaf_histogram(local_binned, w3, B, cfg.chunk,
+                                    bf16=cfg.hist_bf16, n_valid=nv_local,
+                                    group_widths=gw))
     # global leaf sums: the reference Allreduces (cnt, sum_g, sum_h)
     # (data_parallel_tree_learner.cpp:117-145); summing any feature's bins
     # of the already-reduced histogram gives the same totals
@@ -628,9 +652,16 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
 
         ids2k = jnp.concatenate([jnp.where(valid, cl, -1),
                                  jnp.where(valid, cr, -1)])
-        hists = reduce_hist(hist_ops.batched_leaves_histogram(
-            local_binned, w3, leaf_id, ids2k, B, cfg.chunk,
-            bf16=cfg.hist_bf16, n_valid=nv_local))           # [2K, fl, B, 3]
+        if pallas_on:
+            from ..ops import hist_pallas
+            hists = hist_pallas.batched_leaves_histogram_tpu(
+                binned_T, w3, leaf_id, ids2k, B, cfg.chunk,
+                n_valid=nv_local, group_widths=gw)
+        else:
+            hists = reduce_hist(hist_ops.batched_leaves_histogram(
+                local_binned, w3, leaf_id, ids2k, B, cfg.chunk,
+                bf16=cfg.hist_bf16, n_valid=nv_local,
+                group_widths=gw))                            # [2K, fl, B, 3]
 
         # children aggregates from the parents' cached split stats
         sel_c = jnp.clip(sel, 0, M - 1)

@@ -1,0 +1,255 @@
+"""Fused Pallas histogram kernel — the one-hot contraction built in VMEM.
+
+STATUS (round 4, measured on v5e): OPT-IN, off by default. The
+hypothesis motivating this kernel — that the XLA path materializes the
+[chunk, G, B] one-hot in HBM and pays ~2*N*G*B bytes of traffic per
+pass — turned out FALSE: XLA fuses the broadcast-compare into the dot's
+operand generation, and the measured XLA pass (11.1 ms at 2M x 28 x 64
+x 24 leaves) slightly beats this kernel (14.4 ms). The kernel is kept,
+tested (interpret-mode parity in tests/test_ops.py, bit-equal on-chip),
+and wired behind `tpu_hist_pallas=true` because it is the vehicle for
+optimizations XLA cannot express — chiefly sub-32-bit one-hot compares
+(the VPU packs 8/16-bit lanes; currently blocked on Mosaic: no 16-bit
+iota on v5e, no 16-bit minor-dim broadcast) and int8 MXU accumulation.
+
+Replaces the same reference hot loops as ops/histogram.py
+(`DenseBin::ConstructHistogram`, src/io/dense_bin.hpp:66-133; OpenCL
+`histogram256` kernels, src/treelearner/ocl/histogram256.cl:345-790) —
+this is the TPU analogue of the reference's hand-written GPU kernels,
+with the MXU systolic array in place of per-workgroup local memory.
+
+Inputs are ROW-ON-LANES: the kernel takes the TRANSPOSED bin matrix
+[G, N] (the grower already materializes binned.T for split routing), so
+a group sub-tile is a sublane slice and the one-hot lives as
+[sb*B, CH] — built and consumed inside one fori_loop iteration, which
+keeps the live VMEM footprint to a single sub-tile no matter how many
+groups a block holds (an earlier unrolled variant kept every sub-tile's
+one-hot alive and blew the 16 MB scoped-vmem limit on v5e).
+
+Per grid step (j = group block, i = row chunk; i innermost so the
+output block stays VMEM-resident across the row reduction):
+
+  member[CH, C]  = leaf_id_tile == ids          (bf16 0/1)
+  u[CH, 5*C]     = concat_j(member * w5[:, j])  (j-major channels:
+                   g_hi, h_hi, cnt, g_lo, h_lo — hi/lo bf16 split of
+                   the f32 per-row weights, exact for the 0/1 count)
+  fori t over sub-tiles of sb = max(1, 128 // B) groups:
+      oh[sb*B, CH] = bins_t sub-tile == iota%B  (built in VMEM)
+      out[t*sb*B : (t+1)*sb*B, :] += oh @ u     (MXU, f32 accumulate)
+
+The wrapper runs one pallas_call per group-width SEGMENT
+(plan_width_segments): contiguous group ranges scanned at their own
+static bin width — the same bin-width discount the blocked XLA path
+gives (reference 4-bit analogue, src/io/dense_nbits_bin.hpp).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+try:  # pragma: no cover - import guard exercised only off-TPU
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    _PALLAS_OK = True
+except Exception:  # noqa: BLE001
+    _PALLAS_OK = False
+
+
+def available() -> bool:
+    """Pallas path usable on this backend? (TPU only; the XLA blocked
+    kernel is the portable fallback everywhere else.)"""
+    if not _PALLAS_OK:
+        return False
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def plan_width_segments(group_widths, max_segments: int = 4):
+    """Partition the group axis into <= max_segments contiguous ranges,
+    each scanned at its own width (the max inside the range).
+
+    Greedy: start from runs of equal pow2 width class (EFB emits bundles
+    then singletons, so real datasets are already nearly sorted), then
+    merge the pair of adjacent runs with the smallest cost increase
+    (cost = rows * width) until the budget is met.
+
+    Returns tuple of (g_start, g_count, width).
+    """
+    g = len(group_widths)
+    if g == 0:
+        return ()
+    runs = []
+    for idx, w in enumerate(group_widths):
+        w = max(1, int(w))
+        cls = 1 << (w - 1).bit_length()
+        if runs and runs[-1][2] == cls:
+            s, c, _, mw = runs[-1]
+            runs[-1] = (s, c + 1, cls, max(mw, w))
+        else:
+            runs.append((idx, 1, cls, w))
+    while len(runs) > max_segments:
+        best, best_cost = None, None
+        for k in range(len(runs) - 1):
+            s1, c1, _, w1 = runs[k]
+            s2, c2, _, w2 = runs[k + 1]
+            mw = max(w1, w2)
+            cost = (c1 + c2) * mw - c1 * w1 - c2 * w2
+            if best_cost is None or cost < best_cost:
+                best, best_cost = k, cost
+        s1, c1, _, w1 = runs[best]
+        s2, c2, _, w2 = runs[best + 1]
+        mw = max(w1, w2)
+        runs[best:best + 2] = [(s1, c1, 1 << (mw - 1).bit_length(), mw)]
+    return tuple((s, c, w) for s, c, _, w in runs)
+
+
+def _hist_kernel(nvc_ref, iota_ref, bins_t_ref, w_ref, leaf_ref, ids_ref,
+                 out_ref, *, ch, gb, bw):
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _zero():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    @pl.when(i < nvc_ref[0])
+    def _accumulate():
+        member = (leaf_ref[:] == ids_ref[:]).astype(jnp.bfloat16)  # [CH,C]
+        w = w_ref[:]                                               # [CH,8]
+        u = jnp.concatenate([member * w[:, j:j + 1] for j in range(5)],
+                            axis=1)                                # [CH,5C]
+        # one-hot compare in i32 (Mosaic v5e: no 16-bit iota, and 16-bit
+        # minor-dim broadcasts are unsupported — sub-32-bit compares were
+        # tried and don't lower; revisit when Mosaic grows the layouts)
+        bins = bins_t_ref[:].astype(jnp.int32)                     # [gb,CH]
+        iota = iota_ref[:]                                         # [1,bw]
+        oh = (jnp.broadcast_to(bins[:, None, :], (gb, bw, ch))
+              == iota[0][None, :, None]) \
+            .astype(jnp.bfloat16).reshape(gb * bw, ch)             # [gbB,CH]
+        out_ref[:] += jax.lax.dot_general(
+            oh, u, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                    # [gbB,5C]
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("bw", "chunk", "interpret"))
+def _hist_segment(binned_t_seg: jnp.ndarray, w5: jnp.ndarray,
+                  leaf_id2: jnp.ndarray, ids2: jnp.ndarray,
+                  nvc: jnp.ndarray, bw: int, chunk: int,
+                  interpret: bool = False) -> jnp.ndarray:
+    """One width-segment histogram: [Gseg*bw, 5*C] f32.
+
+    binned_t_seg: [Gseg, N] uint8 (TRANSPOSED rows of this segment,
+              N % chunk == 0)
+    w5:       [N, 8] bf16 (g_hi, h_hi, cnt, g_lo, h_lo, 0, 0, 0)
+    leaf_id2: [N, 1] i32
+    ids2:     [1, C] i32
+    nvc:      [1] i32 — number of row chunks containing real rows
+    """
+    gseg, n = binned_t_seg.shape
+    c_ids = ids2.shape[1]
+    ch = min(chunk, 1024)
+    # whole-block one-hot [gb*bw, ch] bf16 stays <= ~4 MB of VMEM
+    gb = max(1, min(gseg, max(1, 2048 // bw)))
+    g_pad = ((gseg + gb - 1) // gb) * gb
+    if g_pad != gseg:
+        binned_t_seg = jnp.pad(binned_t_seg,
+                               ((0, g_pad - gseg), (0, 0)))
+    n_gb = g_pad // gb
+    n_rc = n // ch
+
+    iota32 = jnp.arange(bw, dtype=jnp.int32)[None, :]
+
+    kernel = functools.partial(_hist_kernel, ch=ch, gb=gb, bw=bw)
+    out = pl.pallas_call(
+        kernel,
+        grid=(n_gb, n_rc),
+        in_specs=[
+            pl.BlockSpec((1,), lambda j, i: (0,),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, bw), lambda j, i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((gb, ch), lambda j, i: (j, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((ch, 8), lambda j, i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((ch, 1), lambda j, i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, c_ids), lambda j, i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((gb * bw, 5 * c_ids),
+                               lambda j, i: (j, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((g_pad * bw, 5 * c_ids),
+                                       jnp.float32),
+        interpret=interpret,
+    )(nvc, iota32, binned_t_seg, w5, leaf_id2, ids2)
+    return out[:gseg * bw]
+
+
+def batched_leaves_histogram_tpu(binned_t: jnp.ndarray, weights: jnp.ndarray,
+                                 leaf_id: jnp.ndarray, ids: jnp.ndarray,
+                                 num_bins: int, chunk: int = 16384,
+                                 n_valid=None, group_widths=None,
+                                 interpret: bool = False) -> jnp.ndarray:
+    """Fused-TPU equivalent of ops.histogram.batched_leaves_histogram
+    (bf16 hi/lo mode), taking the TRANSPOSED bin matrix.
+
+    binned_t: [G, N] int bins (padded rows must carry zero `weights`),
+    weights [N, 3] f32, ids [C] i32 (-1 slots allowed — they match no
+    rows). Returns [C, G, num_bins, 3] f32.
+    """
+    g, n = binned_t.shape
+    if n % chunk != 0:
+        raise ValueError(f"rows ({n}) must be padded to a multiple of chunk ({chunk})")
+    c_ids = ids.shape[0]
+    ch = min(chunk, 1024)
+
+    hi = weights.astype(jnp.bfloat16)
+    lo = (weights - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    w5 = jnp.concatenate(
+        [hi, lo[:, 0:2], jnp.zeros((n, 3), jnp.bfloat16)], axis=1)
+    leaf_id2 = leaf_id.astype(jnp.int32)[:, None]
+    ids2 = ids.astype(jnp.int32)[None, :]
+    nvc = (jnp.full((1,), n // ch, jnp.int32) if n_valid is None else
+           jnp.minimum((jnp.asarray(n_valid).astype(jnp.int32) + ch - 1)
+                       // ch, n // ch).reshape(1))
+
+    widths = tuple(int(w) for w in group_widths) if group_widths \
+        else (num_bins,) * g
+    segments = plan_width_segments(widths)
+
+    parts = []
+    for gs, gc, bw in segments:
+        bw = min(bw, num_bins)
+        seg = jax.lax.slice_in_dim(binned_t, gs, gs + gc, axis=0)
+        flat = _hist_segment(seg, w5, leaf_id2, ids2, nvc, bw, chunk,
+                             interpret=interpret)
+        part = flat.reshape(gc, bw, 5, c_ids)
+        main = part[:, :, 0:3, :]
+        hist = main.at[:, :, 0:2, :].add(part[:, :, 3:5, :])
+        if bw < num_bins:
+            hist = jnp.pad(hist, ((0, 0), (0, num_bins - bw),
+                                  (0, 0), (0, 0)))
+        parts.append(hist)
+    full = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=0)
+    return full.transpose(3, 0, 1, 2)                # [C, G, B, 3]
+
+
+def leaf_histogram_tpu(binned_t: jnp.ndarray, weights: jnp.ndarray,
+                       num_bins: int, chunk: int = 16384,
+                       n_valid=None, group_widths=None,
+                       interpret: bool = False) -> jnp.ndarray:
+    """Fused-TPU equivalent of ops.histogram.leaf_histogram (bf16 mode):
+    the root/single-leaf pass as the C=1 case. Takes the TRANSPOSED bin
+    matrix [G, N]. Returns [G, B, 3] f32."""
+    zeros = jnp.zeros(binned_t.shape[1], jnp.int32)
+    ids = jnp.zeros(1, jnp.int32)
+    out = batched_leaves_histogram_tpu(
+        binned_t, weights, zeros, ids, num_bins, chunk,
+        n_valid=n_valid, group_widths=group_widths, interpret=interpret)
+    return out[0]

@@ -49,23 +49,70 @@ def _hi_lo(w):
     return hi, lo
 
 
-def _contract(onehot_bool, w, bf16: bool) -> jnp.ndarray:
-    """[C,F,B] one-hot x [C,S] weights -> [F,B,S] with f32 accumulation."""
-    if bf16:
-        oh = onehot_bool.astype(jnp.bfloat16)
-        hi, lo = _hi_lo(w)
-        out = jnp.einsum("cfb,cs->fbs", oh, hi,
-                         preferred_element_type=jnp.float32)
-        out = out + jnp.einsum("cfb,cs->fbs", oh, lo,
-                               preferred_element_type=jnp.float32)
-        return out
-    # HIGHEST keeps the contraction in true f32 on TPU (the default would
-    # drop the MXU inputs to bf16: fine for grad/hess magnitudes, but the
-    # count channel must stay exact for min_data_in_leaf decisions)
-    return jnp.einsum("cfb,cs->fbs", onehot_bool.astype(jnp.float32),
-                      w.astype(jnp.float32),
-                      preferred_element_type=jnp.float32,
-                      precision=jax.lax.Precision.HIGHEST)
+# one-hot working-set budget per (row-chunk x group-block) contraction step,
+# in elements; bounds the materialized [chunk, Gb, Bb] operand
+_BLOCK_BUDGET = 1 << 26
+
+
+def plan_group_blocks(group_widths, chunk: int,
+                      budget: int = _BLOCK_BUDGET):
+    """Partition the stored-group axis into contiguous blocks, each
+    contracted at its own static bin width.
+
+    This replaces the round-3 scheme of shrinking the ROW chunk as
+    G*B grows (which at Epsilon-like G*B ~ 128k collapsed the chunk to
+    512 rows and exploded the sequential pass count): the row chunk
+    stays constant and the FEATURE-GROUP axis is tiled instead. Each
+    block scans at bin width = max(group widths inside it), so narrow
+    features (the reference's 4-bit path, src/io/dense_nbits_bin.hpp)
+    pay a proportionally narrower one-hot, not the global max width.
+
+    Returns a tuple of (g_start, g_count, bin_width) covering all groups.
+    """
+    g = len(group_widths)
+    if g == 0:
+        return ()
+    blocks = []
+    i = 0
+    while i < g:
+        bw = max(1, int(group_widths[i]))
+        j = i + 1
+        while j < g:
+            nbw = max(bw, int(group_widths[j]))
+            if nbw * (j + 1 - i) * chunk > budget:
+                break
+            bw = nbw
+            j += 1
+        blocks.append((i, j - i, bw))
+        i = j
+    return tuple(blocks)
+
+
+def _contract_blocks(binned, row0, chunk, blocks, num_bins, u, bf16):
+    """One row-chunk's histogram contribution, group-block tiled.
+
+    u: [chunk, S] channel matrix (already masked/hi-lo-packed by the
+    caller). Each block materializes only a [chunk, Gb, Bb] one-hot
+    (Bb = the block's own width) and its [Gb, Bb, S] product is padded
+    up to the uniform output width so downstream indexing is unchanged.
+    Returns [G, num_bins, S] f32."""
+    parts = []
+    for gs, gc, bw in blocks:
+        b_blk = jax.lax.dynamic_slice(binned, (row0, gs), (chunk, gc))
+        oh = _onehot(b_blk, min(bw, num_bins))
+        if bf16:
+            p = jnp.einsum("cfb,cs->fbs", oh.astype(jnp.bfloat16),
+                           u.astype(jnp.bfloat16),
+                           preferred_element_type=jnp.float32)
+        else:
+            p = jnp.einsum("cfb,cs->fbs", oh.astype(jnp.float32),
+                           u.astype(jnp.float32),
+                           preferred_element_type=jnp.float32,
+                           precision=jax.lax.Precision.HIGHEST)
+        if p.shape[1] < num_bins:
+            p = jnp.pad(p, ((0, 0), (0, num_bins - p.shape[1]), (0, 0)))
+        parts.append(p)
+    return parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=0)
 
 
 def _onehot(binned_chunk: jnp.ndarray, num_bins: int) -> jnp.ndarray:
@@ -73,10 +120,12 @@ def _onehot(binned_chunk: jnp.ndarray, num_bins: int) -> jnp.ndarray:
             jnp.arange(num_bins, dtype=binned_chunk.dtype)[None, None, :])
 
 
-@functools.partial(jax.jit, static_argnames=("num_bins", "chunk", "bf16"))
+@functools.partial(jax.jit, static_argnames=("num_bins", "chunk", "bf16",
+                                             "group_widths"))
 def leaf_histogram(binned: jnp.ndarray, weights: jnp.ndarray,
                    num_bins: int, chunk: int = 16384,
-                   bf16: bool = True, n_valid=None) -> jnp.ndarray:
+                   bf16: bool = True, n_valid=None,
+                   group_widths=None) -> jnp.ndarray:
     """hist[f, b, (g,h,cnt)] over rows where the mask channel is nonzero.
 
     Args:
@@ -85,11 +134,14 @@ def leaf_histogram(binned: jnp.ndarray, weights: jnp.ndarray,
       weights: [N, 3] = (grad*mask, hess*mask, mask). Bagging/GOSS weights
                fold into the channels (GOSS amplification multiplies grad
                and hess, the count channel stays 0/1 — goss.hpp:87-131).
-      num_bins: histogram width B (max bins over features).
+      num_bins: OUTPUT histogram width B (max bins over features).
       n_valid: optional traced row count; rows beyond it are PADDING (the
                loader pads as a suffix) and their chunks are skipped by a
                dynamic trip count — row-count buckets can then share one
                compiled signature with ~zero cost for the padding.
+      group_widths: optional static tuple of per-group bin counts; the
+               group axis is then tiled into blocks each scanned at its
+               own width (plan_group_blocks). None = uniform num_bins.
 
     CONTRACT: padding rows must carry all-zero `weights` channels. n_valid
     only skips WHOLE trailing chunks; the partial boundary chunk (and the
@@ -103,72 +155,21 @@ def leaf_histogram(binned: jnp.ndarray, weights: jnp.ndarray,
     if n % chunk != 0:
         raise ValueError(f"rows ({n}) must be padded to a multiple of chunk ({chunk})")
     n_chunks = n // chunk
+    widths = group_widths if group_widths else (num_bins,) * f
+    blocks = plan_group_blocks(widths, chunk)
+    s = 5 if bf16 else 3
 
     def one(c):
-        b_chunk = jax.lax.dynamic_slice(binned, (c * chunk, 0), (chunk, f))
         w_chunk = jax.lax.dynamic_slice(weights, (c * chunk, 0), (chunk, 3))
-        return _contract(_onehot(b_chunk, num_bins), w_chunk, bf16)
-
-    if n_chunks == 1:
-        return one(jnp.int32(0))
-
-    def body(c, acc):
-        return acc + one(c)
-
-    trip = n_chunks if n_valid is None else \
-        jnp.minimum((n_valid + chunk - 1) // chunk, n_chunks)
-    init = jnp.zeros((f, num_bins, 3), dtype=jnp.float32)
-    return jax.lax.fori_loop(0, trip, body, init)
-
-
-@functools.partial(jax.jit,
-                   static_argnames=("num_bins", "chunk", "bf16"))
-def batched_leaves_histogram(binned: jnp.ndarray, weights: jnp.ndarray,
-                             leaf_id: jnp.ndarray, ids: jnp.ndarray,
-                             num_bins: int, chunk: int = 16384,
-                             bf16: bool = True, n_valid=None) -> jnp.ndarray:
-    """Histograms of C arbitrary leaf-label ids in one data pass.
-
-    The speculative grower (learner/grow.py) relabels rows to child node
-    ids BEFORE building their histograms, so membership is a direct
-    `leaf_id == ids[k]` compare — no split bit. Returns [C, F, B, 3].
-
-    Two deliberate design choices, both profiled on hardware:
-    - rows are walked with `lax.dynamic_slice` chunks instead of an
-      upfront reshape to [n_chunks, chunk, F]: the reshape forced XLA to
-      materialize two layout copies of the whole bin matrix per pass
-      (~0.15 ms/pass at 0.5M rows — `profiles/README.md` round 2);
-    - the contraction's MXU output tile is 128 lanes no matter how few
-      channels are live, so C is sized by the caller to fill it
-      (C*(3 hi + 2 lo) <= 128, i.e. C <= 25) — extra slots are free.
-    """
-    n, f = binned.shape
-    if n % chunk != 0:
-        raise ValueError(f"rows ({n}) must be padded to a multiple of chunk ({chunk})")
-    c_ids = ids.shape[0]
-    n_chunks = n // chunk
-
-    def one(c):
-        b_chunk = jax.lax.dynamic_slice(binned, (c * chunk, 0), (chunk, f))
-        w_chunk = jax.lax.dynamic_slice(weights, (c * chunk, 0), (chunk, 3))
-        lid = jax.lax.dynamic_slice(leaf_id, (c * chunk,), (chunk,))
-        member = lid[:, None] == ids[None, :]                  # [C, K]
-        oh = _onehot(b_chunk, num_bins)
-        if not bf16:
-            u = (member[:, :, None].astype(jnp.float32)
-                 * w_chunk[:, None, :]).reshape(chunk, c_ids * 3)
-            return _contract(oh, u, False)
-        hi, lo = _hi_lo(w_chunk)
-        mb = member[:, :, None].astype(jnp.bfloat16)
-        u_hi = (mb * hi[:, None, :]).reshape(chunk, c_ids * 3)
-        u_lo = (mb[:, :, 0:2] * lo[:, None, 0:2]).reshape(chunk, c_ids * 2)
-        u = jnp.concatenate([u_hi, u_lo], axis=1)
-        both = jnp.einsum("cfb,cs->fbs", oh.astype(jnp.bfloat16), u,
-                          preferred_element_type=jnp.float32)
-        main = both[:, :, :c_ids * 3].reshape(f, num_bins, c_ids, 3)
-        corr = both[:, :, c_ids * 3:].reshape(f, num_bins, c_ids, 2)
-        return (main.at[:, :, :, 0:2].add(corr)
-                .reshape(f, num_bins, c_ids * 3))
+        if bf16:
+            hi, lo = _hi_lo(w_chunk)
+            # count channel is 0/1 = bf16-exact, so only grad/hess need
+            # the lo correction: S = 3 hi + 2 lo
+            u = jnp.concatenate([hi, lo[:, 0:2]], axis=1)
+        else:
+            u = w_chunk
+        return _contract_blocks(binned, c * chunk, chunk, blocks,
+                                num_bins, u, bf16)
 
     if n_chunks == 1:
         hist = one(jnp.int32(0))
@@ -178,8 +179,82 @@ def batched_leaves_histogram(binned: jnp.ndarray, weights: jnp.ndarray,
 
         trip = n_chunks if n_valid is None else \
             jnp.minimum((n_valid + chunk - 1) // chunk, n_chunks)
-        init = jnp.zeros((f, num_bins, c_ids * 3), dtype=jnp.float32)
+        init = jnp.zeros((f, num_bins, s), dtype=jnp.float32)
         hist = jax.lax.fori_loop(0, trip, body, init)
+    if bf16:
+        hist = hist[:, :, 0:3].at[:, :, 0:2].add(hist[:, :, 3:5])
+    return hist
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_bins", "chunk", "bf16",
+                                    "group_widths"))
+def batched_leaves_histogram(binned: jnp.ndarray, weights: jnp.ndarray,
+                             leaf_id: jnp.ndarray, ids: jnp.ndarray,
+                             num_bins: int, chunk: int = 16384,
+                             bf16: bool = True, n_valid=None,
+                             group_widths=None) -> jnp.ndarray:
+    """Histograms of C arbitrary leaf-label ids in one data pass.
+
+    The speculative grower (learner/grow.py) relabels rows to child node
+    ids BEFORE building their histograms, so membership is a direct
+    `leaf_id == ids[k]` compare — no split bit. Returns [C, F, B, 3].
+
+    Three deliberate design choices, the first two profiled on hardware:
+    - rows are walked with `lax.dynamic_slice` chunks instead of an
+      upfront reshape to [n_chunks, chunk, F]: the reshape forced XLA to
+      materialize two layout copies of the whole bin matrix per pass
+      (~0.15 ms/pass at 0.5M rows — `profiles/README.md` round 2);
+    - the contraction's MXU output tile is 128 lanes no matter how few
+      channels are live, so C is sized by the caller to fill it
+      (C*(3 hi + 2 lo) <= 128, i.e. C <= 25) — extra slots are free
+      on narrow-feature data where F*B underfills the other tile axis;
+    - for WIDE data the group axis is tiled into constant-row-chunk
+      blocks (plan_group_blocks), each scanned at its own bin width —
+      the row chunk no longer shrinks with G*B, and <=16-bin features
+      get the reference 4-bit path's cost discount
+      (src/io/dense_nbits_bin.hpp:1-405).
+    """
+    n, f = binned.shape
+    if n % chunk != 0:
+        raise ValueError(f"rows ({n}) must be padded to a multiple of chunk ({chunk})")
+    c_ids = ids.shape[0]
+    n_chunks = n // chunk
+    widths = group_widths if group_widths else (num_bins,) * f
+    blocks = plan_group_blocks(widths, chunk)
+    s = c_ids * 5 if bf16 else c_ids * 3
+
+    def one(c):
+        w_chunk = jax.lax.dynamic_slice(weights, (c * chunk, 0), (chunk, 3))
+        lid = jax.lax.dynamic_slice(leaf_id, (c * chunk,), (chunk,))
+        member = lid[:, None] == ids[None, :]                  # [C, K]
+        if bf16:
+            hi, lo = _hi_lo(w_chunk)
+            mb = member[:, :, None].astype(jnp.bfloat16)
+            u_hi = (mb * hi[:, None, :]).reshape(chunk, c_ids * 3)
+            u_lo = (mb[:, :, 0:2] * lo[:, None, 0:2]).reshape(chunk, c_ids * 2)
+            u = jnp.concatenate([u_hi, u_lo], axis=1)
+        else:
+            u = (member[:, :, None].astype(jnp.float32)
+                 * w_chunk[:, None, :]).reshape(chunk, c_ids * 3)
+        return _contract_blocks(binned, c * chunk, chunk, blocks,
+                                num_bins, u, bf16)
+
+    if n_chunks == 1:
+        hist = one(jnp.int32(0))
+    else:
+        def body(c, acc):
+            return acc + one(c)
+
+        trip = n_chunks if n_valid is None else \
+            jnp.minimum((n_valid + chunk - 1) // chunk, n_chunks)
+        init = jnp.zeros((f, num_bins, s), dtype=jnp.float32)
+        hist = jax.lax.fori_loop(0, trip, body, init)
+    if bf16:
+        main = hist[:, :, :c_ids * 3].reshape(f, num_bins, c_ids, 3)
+        corr = hist[:, :, c_ids * 3:].reshape(f, num_bins, c_ids, 2)
+        hist = (main.at[:, :, :, 0:2].add(corr)
+                .reshape(f, num_bins, c_ids * 3))
     return hist.reshape(f, num_bins, c_ids, 3).transpose(2, 0, 1, 3)
 
 

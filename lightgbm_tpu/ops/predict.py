@@ -250,6 +250,19 @@ def predict_forest_raw(stacked: DeviceTree, data: jnp.ndarray) -> jnp.ndarray:
     return out
 
 
+def predict_forest_leaf_raw(stacked: DeviceTree,
+                            data: jnp.ndarray) -> jnp.ndarray:
+    """Leaf index per (row, tree) as ONE scanned dispatch: [N, T] i32
+    (reference: Predictor::PredictLeafIndex, predictor.hpp:84-101 — the
+    TPU shape of it, consistent with the stacked value path instead of
+    one dispatch per tree)."""
+    def body(_, tree):
+        return None, predict_leaf_raw(tree, data)
+
+    _, leaves = jax.lax.scan(body, None, stacked)   # [T, N]
+    return leaves.T.astype(jnp.int32)
+
+
 def predict_forest_raw_early_stop(stacked_kt: DeviceTree, data: jnp.ndarray,
                                   margin: float, freq: int) -> jnp.ndarray:
     """Per-row margin-based prediction early stop

@@ -3,18 +3,26 @@
 Builds /root/reference out-of-tree (its CMakeLists drops binaries into the
 source dir via EXECUTABLE_OUTPUT_PATH; we redirect both output paths into
 the build dir so the read-only reference tree stays pristine), generates
-the exact synthetic dataset bench.py uses, trains with the same
-hyperparameters through the reference CLI, and writes BENCH_BASELINE.json
-at the repo root with the measured mrow_iters/s.
+the exact synthetic datasets bench.py uses, trains with the same
+hyperparameters through the reference CLI, and records the measured
+mrow_iters/s:
 
-bench.py reads BENCH_BASELINE.json to report an honest vs_baseline.
+- BENCH_BASELINE.json        — the HIGGS-like headline shape (legacy
+                               layout, kept for round-over-round compat)
+- BENCH_BASELINE_SHAPES.json — {shape: {...}} for the wide/sparse/
+                               categorical shapes (bench.py reads these
+                               for per-shape vs_baseline)
 
-The recorded `mrows_per_sec` is max(measured-here, REFERENCE_8T_FLOOR):
-this box may expose fewer cores than the reference's benchmark setup
-(docs/GPU-Performance.md:96-116 used 28 threads), and an undersized
-baseline would flatter vs_baseline. REFERENCE_8T_FLOOR is the 8-thread
-measurement of this exact workload recorded in round 1's review
+Usage: python scripts/measure_baseline.py [shape ...]
+       (default: higgs; "all" = every bench.py shape)
+
+The recorded `mrows_per_sec` is max(measured-here, REFERENCE_8T_FLOOR)
+for the higgs shape: this box may expose fewer cores than the reference's
+benchmark setup (docs/GPU-Performance.md:96-116 used 28 threads), and an
+undersized baseline would flatter vs_baseline. REFERENCE_8T_FLOOR is the
+8-thread measurement of this exact workload recorded in round 1's review
 (VERDICT.md: 20.2 s train on 500k x 28 x 20 iters = 0.495 mrow_iters/s).
+Other shapes record the raw measurement (threads = all visible cores).
 
 MUST run on an otherwise-idle machine: this box exposes ONE cpu to the
 process, and a concurrently-running test suite silently tripled the
@@ -58,39 +66,62 @@ def build_reference() -> str:
     return exe
 
 
-def main():
+def _write_tsv(path: str, y, X) -> None:
+    """Fast-enough TSV writer for wide matrices (np.savetxt is a Python
+    loop; pandas' C writer is ~10x faster and keeps full precision
+    unnecessary for binned training)."""
     import numpy as np
+    X = np.round(np.asarray(X, np.float64), 4)
+    try:
+        import pandas as pd
+        df = pd.DataFrame(np.column_stack([np.asarray(y, np.float64), X]))
+        df.to_csv(path, sep="\t", header=False, index=False)
+    except ImportError:
+        np.savetxt(path, np.column_stack([y, X]), fmt="%.4g", delimiter="\t")
 
-    from bench import MAX_BIN, N_FEATURES, N_ITERS, N_ROWS, NUM_LEAVES, synth_higgs
 
-    exe = build_reference()
-    X, y = synth_higgs(N_ROWS, N_FEATURES)
-    # the row count keys the cache: a BENCH_ROWS change must not silently
-    # reuse a stale dataset while the throughput math uses the new count
-    data_path = os.path.join(BUILD_DIR, f"bench_{N_ROWS}.train")
+def measure_shape(exe: str, shape: str) -> dict:
+    import bench
+
+    n_rows, builder, max_bin = bench.SHAPES[shape]
+    built = builder(n_rows)
+    cat_idx = built[2] if len(built) == 3 else None
+    X, y = built[0], built[1]
+
+    # TSV cache keyed by (builder, rows): epsilon and epsilon15 share the
+    # same matrix (they differ only in max_bin) — only the .bin cache
+    # below needs the per-shape key
+    data_path = os.path.join(
+        BUILD_DIR, f"bench_{builder.__name__}_{n_rows}.train")
     if not os.path.exists(data_path):
-        arr = np.column_stack([y, X])
-        np.savetxt(data_path, arr, fmt="%.6g", delimiter="\t")
+        _write_tsv(data_path, y, X)
 
     conf = {
         "task": "train", "objective": "binary", "metric": "auc",
-        "data": data_path, "num_trees": N_ITERS, "learning_rate": 0.1,
-        "num_leaves": NUM_LEAVES, "max_bin": MAX_BIN, "min_data_in_leaf": 1,
+        "data": data_path, "num_trees": bench.N_ITERS,
+        "learning_rate": 0.1, "num_leaves": bench.NUM_LEAVES,
+        "max_bin": max_bin, "min_data_in_leaf": 1,
         "min_sum_hessian_in_leaf": 100.0, "verbosity": 1,
         "num_threads": os.cpu_count() or 1,
-        "output_model": os.path.join(BUILD_DIR, "bench_model.txt"),
+        "output_model": os.path.join(BUILD_DIR, f"bench_{shape}_model.txt"),
     }
-    args = [exe] + [f"{k}={v}" for k, v in conf.items()]
+    if cat_idx is not None:
+        conf["categorical_feature"] = ",".join(str(c) for c in cat_idx)
 
     # one untimed run loads/caches the binned dataset file; the timed run
-    # then measures training the way bench.py does (construct untimed)
-    bin_path = data_path + ".bin"
+    # then measures training the way bench.py does (construct untimed).
+    # NOTE: the binary caches max_bin/categorical config, so the cache is
+    # keyed per shape (epsilon vs epsilon15 differ only in max_bin).
+    bin_path = data_path + f".{shape}.bin"
     if not os.path.exists(bin_path):
-        subprocess.run([exe, f"data={data_path}", "task=train", "num_trees=1",
-                        f"max_bin={MAX_BIN}", "save_binary=true",
-                        "objective=binary", "min_data_in_leaf=1",
-                        f"output_model={os.path.join(BUILD_DIR, 'warm_model.txt')}"],
-                       check=True, capture_output=True, cwd=BUILD_DIR)
+        warm = [exe, f"data={data_path}", "task=train", "num_trees=1",
+                f"max_bin={max_bin}", "save_binary=true",
+                "objective=binary", "min_data_in_leaf=1",
+                f"output_model={os.path.join(BUILD_DIR, 'warm_model.txt')}"]
+        if cat_idx is not None:
+            warm.append("categorical_feature=" + ",".join(str(c) for c in cat_idx))
+        subprocess.run(warm, check=True, capture_output=True, cwd=BUILD_DIR)
+        os.replace(data_path + ".bin", bin_path)
     conf["data"] = bin_path
     args = [exe] + [f"{k}={v}" for k, v in conf.items()]
 
@@ -107,20 +138,45 @@ def main():
             except (ValueError, IndexError):
                 pass
 
-    measured = N_ROWS * N_ITERS / train_time / 1e6
-    result = {
-        "mrows_per_sec": round(max(measured, REFERENCE_8T_FLOOR), 4),
+    measured = n_rows * bench.N_ITERS / train_time / 1e6
+    rec = measured if shape != "higgs" else max(measured, REFERENCE_8T_FLOOR)
+    return {
+        "mrows_per_sec": round(rec, 4),
         "measured_here": round(measured, 4),
-        "reference_8thread_floor": REFERENCE_8T_FLOOR,
         "train_seconds": round(train_time, 3),
         "wall_seconds": round(wall, 3),
         "threads": os.cpu_count() or 1,
-        "rows": N_ROWS, "features": N_FEATURES, "iters": N_ITERS,
-        "num_leaves": NUM_LEAVES, "max_bin": MAX_BIN,
+        "rows": n_rows, "features": int(X.shape[1]),
+        "iters": bench.N_ITERS,
+        "num_leaves": bench.NUM_LEAVES, "max_bin": max_bin,
     }
-    with open(os.path.join(REPO, "BENCH_BASELINE.json"), "w") as fh:
-        json.dump(result, fh, indent=1)
-    print(json.dumps(result))
+
+
+def main():
+    import bench
+
+    shapes = sys.argv[1:] or ["higgs"]
+    if shapes == ["all"]:
+        shapes = list(bench.SHAPES)
+    exe = build_reference()
+
+    shapes_path = os.path.join(REPO, "BENCH_BASELINE_SHAPES.json")
+    all_results = {}
+    if os.path.exists(shapes_path):
+        with open(shapes_path) as fh:
+            all_results = json.load(fh)
+
+    for shape in shapes:
+        result = measure_shape(exe, shape)
+        if shape == "higgs":
+            result["reference_8thread_floor"] = REFERENCE_8T_FLOOR
+            with open(os.path.join(REPO, "BENCH_BASELINE.json"), "w") as fh:
+                json.dump(result, fh, indent=1)
+        else:
+            all_results[shape] = result
+            with open(shapes_path, "w") as fh:
+                json.dump(all_results, fh, indent=1)
+        print(shape, json.dumps(result), flush=True)
 
 
 if __name__ == "__main__":
