@@ -82,6 +82,17 @@ def synth_bosch(n, f=968, seed=2):
     return X, y
 
 
+def synth_multiclass(n, f=28, k=5, seed=4):
+    """Multiclass shape (no reference-published analogue; exercises the
+    one-program-per-iteration vmap'd class growth, gbdt.cpp:410-462)."""
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f).astype(np.float32)
+    centers = rng.randn(k, 6) * 1.5
+    d = ((X[:, None, :6] - centers[None]) ** 2).sum(-1)
+    y = np.argmin(d + rng.gumbel(size=(n, k)), axis=1).astype(np.float32)
+    return X, y
+
+
 def synth_expo(n, seed=3):
     """Expo-like: mixed categorical + numeric (the reference one-hot
     encodes Expo to 700 binary columns; the native-categorical path is
@@ -109,6 +120,8 @@ SHAPES = {
               synth_bosch, 63),
     "expo": (int(os.environ.get("BENCH_EXPO_ROWS", 1_000_000)),
              synth_expo, 63),
+    "multiclass": (int(os.environ.get("BENCH_MC_ROWS", 500_000)),
+                   synth_multiclass, 63),
 }
 
 
@@ -152,6 +165,9 @@ def run_shape(shape: str) -> dict:
         # speculative batch trades ~1.6x fewer channel-lanes per pass for
         # few extra passes (measured 3.9s vs 6.5s per tree at 500k rows)
         params["tpu_batch_k"] = 4
+    if shape == "multiclass":
+        params.update(objective="multiclass", num_class=5,
+                      metric="multi_logloss")
     ds = lgb.Dataset(X, y, params=dict(params))
     ds.construct()
 

@@ -1,0 +1,105 @@
+"""Per-round accuracy anchor (VERDICT r3 item 9): train ours on the real
+chip and the reference binary on the same synthetic HIGGS-like split at
+500-iteration scale, and record both holdout AUCs side by side in
+ACCURACY_r{N}.json.
+
+The reference anchors its quality story at HIGGS AUC 0.845239 @ 63 bins /
+500 iters (docs/GPU-Performance.md:134); on synthetic data the absolute
+number differs, so the artifact records the DELTA vs the reference binary
+trained with identical hyperparameters on identical rows — accuracy
+regressions then show up round-over-round like throughput ones.
+
+Usage: python scripts/measure_accuracy.py [round_no] [rows] [iters]
+       (reference half needs the CPU otherwise idle)
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+PARAMS = {"objective": "binary", "metric": "auc", "verbose": -1,
+          "max_bin": 63, "num_leaves": 255, "learning_rate": 0.1,
+          "min_data_in_leaf": 1, "min_sum_hessian_in_leaf": 100.0}
+
+
+def _auc(y, p):
+    import numpy as np
+    order = np.argsort(p)
+    ranks = np.empty(len(p))
+    ranks[order] = np.arange(1, len(p) + 1)
+    pos = y > 0
+    n_pos, n_neg = pos.sum(), (~pos).sum()
+    return (ranks[pos].sum() - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg)
+
+
+def main(round_no: int = 4, rows: int = 500_000, iters: int = 500):
+    import numpy as np
+
+    import bench
+    import lightgbm_tpu as lgb
+    from measure_baseline import BUILD_DIR, build_reference
+
+    n_test = rows // 5
+    X, y = bench.synth_higgs(rows + n_test, 28, seed=11)
+    Xtr, ytr, Xte, yte = X[:rows], y[:rows], X[rows:], y[rows:]
+
+    # ours, on whatever accelerator is attached
+    ds = lgb.Dataset(Xtr, ytr, params=dict(PARAMS))
+    t0 = time.time()
+    booster = lgb.train(dict(PARAMS), ds, num_boost_round=iters,
+                        verbose_eval=False)
+    ours_wall = time.time() - t0
+    ours_auc = float(_auc(yte, booster.predict(Xte, raw_score=True)))
+
+    # reference binary, CPU
+    exe = build_reference()
+    os.makedirs(BUILD_DIR, exist_ok=True)
+    tr = os.path.join(BUILD_DIR, f"acc_{rows}.train")
+    te = os.path.join(BUILD_DIR, f"acc_{rows}.test")
+    if not os.path.exists(tr):
+        np.savetxt(tr, np.column_stack([ytr, Xtr]), fmt="%.6g",
+                   delimiter="\t")
+        np.savetxt(te, np.column_stack([yte, Xte]), fmt="%.6g",
+                   delimiter="\t")
+    model = os.path.join(BUILD_DIR, "acc_model.txt")
+    conf = dict(PARAMS)
+    conf.pop("verbose")
+    conf.update(task="train", data=tr, num_trees=iters, verbosity=1,
+                output_model=model, num_threads=os.cpu_count() or 1)
+    t0 = time.time()
+    subprocess.run([exe] + [f"{k}={v}" for k, v in conf.items()],
+                   check=True, capture_output=True)
+    ref_wall = time.time() - t0
+    preds = os.path.join(BUILD_DIR, "acc_preds.txt")
+    subprocess.run([exe, "task=predict", f"data={te}",
+                    f"input_model={model}", f"output_result={preds}",
+                    "predict_raw_score=true"],
+                   check=True, capture_output=True)
+    ref_auc = float(_auc(yte, np.loadtxt(preds)))
+
+    result = {
+        "rows": rows, "test_rows": n_test, "iters": iters,
+        "max_bin": PARAMS["max_bin"], "num_leaves": PARAMS["num_leaves"],
+        "ours_auc": round(ours_auc, 6), "ref_auc": round(ref_auc, 6),
+        "auc_delta": round(ours_auc - ref_auc, 6),
+        "ours_train_wall_s": round(ours_wall, 1),
+        "ref_train_wall_s": round(ref_wall, 1),
+        "reference_published_anchor": "HIGGS AUC 0.845239 @63 bins/500 "
+                                      "iters (docs/GPU-Performance.md:134)",
+    }
+    out = os.path.join(REPO, f"ACCURACY_r{round_no:02d}.json")
+    with open(out, "w") as fh:
+        json.dump(result, fh, indent=1)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    args = [int(float(a)) for a in sys.argv[1:]]
+    main(*args)

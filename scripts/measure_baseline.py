@@ -107,6 +107,9 @@ def measure_shape(exe: str, shape: str) -> dict:
     }
     if cat_idx is not None:
         conf["categorical_feature"] = ",".join(str(c) for c in cat_idx)
+    if shape == "multiclass":
+        conf.update(objective="multiclass", num_class=5,
+                    metric="multi_logloss")
 
     # one untimed run loads/caches the binned dataset file; the timed run
     # then measures training the way bench.py does (construct untimed).
@@ -116,8 +119,10 @@ def measure_shape(exe: str, shape: str) -> dict:
     if not os.path.exists(bin_path):
         warm = [exe, f"data={data_path}", "task=train", "num_trees=1",
                 f"max_bin={max_bin}", "save_binary=true",
-                "objective=binary", "min_data_in_leaf=1",
+                f"objective={conf['objective']}", "min_data_in_leaf=1",
                 f"output_model={os.path.join(BUILD_DIR, 'warm_model.txt')}"]
+        if conf.get("num_class"):
+            warm.append(f"num_class={conf['num_class']}")
         if cat_idx is not None:
             warm.append("categorical_feature=" + ",".join(str(c) for c in cat_idx))
         subprocess.run(warm, check=True, capture_output=True, cwd=BUILD_DIR)

@@ -213,6 +213,66 @@ def ref_ranking(rows=2_000_000, iters=15):
     _save(data)
 
 
+def _predict_fixture(rows=500_000, trees=100):
+    """Shared file fixture for the prediction race: OUR model text (the
+    formats cross-load, tests/test_reference_parity.py) + a TSV to score.
+    Returns (model_path, data_path)."""
+    from measure_baseline import BUILD_DIR
+    import numpy as np
+
+    import bench
+    os.makedirs(BUILD_DIR, exist_ok=True)
+    model = os.path.join(BUILD_DIR, f"predict_model_{rows}_{trees}.txt")
+    data = os.path.join(BUILD_DIR, f"predict_data_{rows}.tsv")
+    if not os.path.exists(data):
+        X, y = bench.synth_higgs(rows, 28, seed=7)
+        np.savetxt(data, np.column_stack([y, X]), fmt="%.6g",
+                   delimiter="\t")
+    if not os.path.exists(model):
+        import lightgbm_tpu as lgb
+        X, y = bench.synth_higgs(rows, 28, seed=7)
+        ds = lgb.Dataset(X, y, params=dict(PARAMS))
+        booster = lgb.train(dict(PARAMS), ds, num_boost_round=trees,
+                            verbose_eval=False)
+        booster.save_model(model)
+    return model, data
+
+
+def ours_predict(rows=500_000, trees=100):
+    """Prediction throughput through OUR CLI file path (the reference's
+    Predictor analogue, predictor.hpp:24-205)."""
+    model, data_path = _predict_fixture(int(rows), int(trees))
+    out_path = os.path.join(os.path.dirname(model), "ours_preds.txt")
+    from lightgbm_tpu.cli import main as cli_main
+    t0 = time.time()
+    cli_main([f"task=predict", f"data={data_path}",
+              f"input_model={model}", f"output_result={out_path}"])
+    wall = time.time() - t0
+    data = _load()
+    data["ours_predict"] = {
+        "rows": int(rows), "trees": int(trees), "wall_s": round(wall, 2),
+        "mrows_per_s": round(int(rows) / wall / 1e6, 3)}
+    _save(data)
+
+
+def ref_predict(rows=500_000, trees=100):
+    from measure_baseline import BUILD_DIR, build_reference
+    exe = build_reference()
+    model, data_path = _predict_fixture(int(rows), int(trees))
+    out_path = os.path.join(BUILD_DIR, "ref_preds.txt")
+    args = [exe, "task=predict", f"data={data_path}",
+            f"input_model={model}", f"output_result={out_path}",
+            f"num_threads={os.cpu_count() or 1}"]
+    t0 = time.time()
+    subprocess.run(args, check=True, capture_output=True, text=True)
+    wall = time.time() - t0
+    data = _load()
+    data["ref_predict"] = {
+        "rows": int(rows), "trees": int(trees), "wall_s": round(wall, 2),
+        "mrows_per_s": round(int(rows) / wall / 1e6, 3)}
+    _save(data)
+
+
 if __name__ == "__main__":
     mode = sys.argv[1]
     rest = sys.argv[2:]
@@ -228,5 +288,9 @@ if __name__ == "__main__":
         ours_ranking(*[int(float(r)) for r in rest])
     elif mode == "ref-ranking":
         ref_ranking(*[int(float(r)) for r in rest])
+    elif mode == "ours-predict":
+        ours_predict(*[int(float(r)) for r in rest])
+    elif mode == "ref-predict":
+        ref_predict(*[int(float(r)) for r in rest])
     else:
         raise SystemExit(f"unknown mode {mode}")

@@ -302,3 +302,56 @@ def test_pallas_histogram_n_valid_interpret():
         jnp.asarray(binned.T), jnp.asarray(w), B, chunk=256,
         n_valid=jnp.int32(n_real), interpret=True))
     np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_plan_group_blocks_properties():
+    """Block plan must cover all groups contiguously, respect the
+    working-set budget, and give narrow groups a narrow scan width
+    (the dense_nbits 4-bit analogue, src/io/dense_nbits_bin.hpp)."""
+    from lightgbm_tpu.ops.histogram import plan_group_blocks
+    widths = (16,) * 100 + (256, 256) + (16,) * 50
+    chunk = 4096
+    blocks = plan_group_blocks(widths, chunk)
+    # contiguous full cover
+    nxt = 0
+    for gs, gc, bw in blocks:
+        assert gs == nxt and gc >= 1
+        assert bw >= max(widths[gs:gs + gc])
+        assert bw * gc * chunk <= (1 << 26)
+        nxt = gs + gc
+    assert nxt == len(widths)
+    # the leading narrow run is NOT scanned at 256
+    assert blocks[0][2] < 256
+    # uniform narrow config pays 16, not the global max
+    uniform = plan_group_blocks((16,) * 64, chunk)
+    assert all(bw == 16 for _, _, bw in uniform)
+
+
+def test_goss_device_weights_semantics():
+    """Device GOSS: top_rate rows by |g*h| always kept at weight 1; the
+    rest Bernoulli-sampled at the amplified weight (goss.hpp:87-131)."""
+    from lightgbm_tpu.boosting.goss import _goss_weights_device
+    rng = np.random.RandomState(0)
+    n, n_pad = 1000, 1024
+    g = np.zeros(n_pad, np.float32)
+    h = np.ones(n_pad, np.float32)
+    g[:n] = rng.randn(n)
+    top_k, other_k = 200, 100
+    w = np.asarray(_goss_weights_device(
+        jnp.asarray(g), jnp.asarray(h), seed=3, iter_idx=5, k=1,
+        n=n, n_pad=n_pad, top_k=top_k, other_k=other_k))
+    mag = np.abs(g[:n] * h[:n])
+    thresh = np.sort(mag)[-top_k]
+    assert (w[:n][mag >= thresh] == 1.0).all()
+    multiply = (n - top_k) / other_k
+    rest = w[:n][mag < thresh]
+    assert set(np.unique(rest)).issubset({0.0, np.float32(multiply)})
+    n_sampled = (rest > 0).sum()
+    assert 40 <= n_sampled <= 200   # E=100, Bernoulli
+    # padding rows never selected
+    assert (w[n:] == 0).all()
+    # deterministic per (seed, iter)
+    w2 = np.asarray(_goss_weights_device(
+        jnp.asarray(g), jnp.asarray(h), seed=3, iter_idx=5, k=1,
+        n=n, n_pad=n_pad, top_k=top_k, other_k=other_k))
+    np.testing.assert_array_equal(w, w2)
