@@ -53,6 +53,18 @@ def _jit_forest_binned(stacked, binned):
     return _forest_binned_jit(stacked, binned)
 
 
+_forest_raw_matmul_jit = None
+
+
+def _jit_forest_raw_matmul(mf, data):
+    import jax
+    from ..ops.predict import predict_forest_raw_matmul
+    global _forest_raw_matmul_jit
+    if _forest_raw_matmul_jit is None:
+        _forest_raw_matmul_jit = jax.jit(predict_forest_raw_matmul)
+    return _forest_raw_matmul_jit(mf, data)
+
+
 def _pallas_available() -> bool:
     from ..ops import hist_pallas
     return hist_pallas.available()
@@ -761,39 +773,40 @@ class GBDT:
     # ------------------------------------------------------------------
     # prediction (reference: gbdt_prediction.cpp + Predictor)
 
-    # rows per device dispatch: large forests (100+ trees) over >=500k-row
-    # batches reproducibly fault the relay-attached TPU worker; chunking
-    # bounds the per-dispatch working set with negligible overhead
+    # rows per device dispatch. The WALK path (categorical models) keeps
+    # small batches: large forests over >=500k-row walk dispatches
+    # reproducibly fault the relay-attached TPU worker. The matmul path
+    # takes much larger batches — per-chunk upload+dispatch overhead
+    # dominated at 2^17 (measured 14s -> 5.5s for 500k x 100 trees).
     _PREDICT_ROW_CHUNK = 1 << 17
+    _PREDICT_ROW_CHUNK_MATMUL = 1 << 19
 
     def _predict_raw_matrix(self, data: np.ndarray,
                             num_iteration: int = -1,
                             pred_early_stop: bool = False,
                             pred_early_stop_freq: int = 10,
                             pred_early_stop_margin: float = 10.0) -> np.ndarray:
-        """Raw scores [num_data, num_tree_per_iteration] from raw features."""
-        data = np.asarray(data, np.float32)
-        if data.shape[0] > self._PREDICT_ROW_CHUNK:
-            c = self._PREDICT_ROW_CHUNK
-            return np.concatenate(
-                [self._predict_raw_matrix(
-                    data[i:i + c], num_iteration, pred_early_stop,
-                    pred_early_stop_freq, pred_early_stop_margin)
-                 for i in range(0, data.shape[0], c)], axis=0)
+        """Raw scores [num_data, num_tree_per_iteration] from raw features.
+
+        Trees are stacked to device ONCE; only the row axis is chunked
+        (large forests over >=500k-row single dispatches reproducibly
+        fault the relay-attached TPU worker)."""
         import jax
         import jax.numpy as jnp
+        data = np.asarray(data, np.float32)
         n = data.shape[0]
         k = self.num_tree_per_iteration
         total = len(self.models)
         if num_iteration > 0:
             total = min(total, num_iteration * k)
+        out = np.zeros((k, n), np.float64)
         # margin-based prediction early stop (predictor.hpp:34-60: binary
         # and multiclass objectives only)
         use_es = (pred_early_stop and total > 0
                   and (k > 1 or (self.objective is not None
                                  and self.objective.name == "binary")))
-        out = np.zeros((k, n), np.float64)
-        dj = jnp.asarray(data)
+        stacked_kt = None
+        class_stacks = []
         if use_es:
             from ..ops.predict import (predict_forest_raw_early_stop,
                                        stack_trees_raw)
@@ -803,18 +816,38 @@ class GBDT:
             stacked_kt = jax.tree.map(
                 lambda a: jnp.swapaxes(
                     a.reshape((t_iters, k) + a.shape[1:]), 0, 1), stacked)
-            out = np.asarray(predict_forest_raw_early_stop(
-                stacked_kt, dj, float(pred_early_stop_margin),
-                int(pred_early_stop_freq)), np.float64)
         elif total > 0:
-            from ..ops.predict import predict_forest_raw, stack_trees_raw
+            from ..ops.predict import stack_trees_matmul, stack_trees_raw
             for cls in range(k):
                 class_trees = [self.models[i] for i in range(cls, total, k)]
-                if not class_trees:
-                    continue
-                stacked = stack_trees_raw(class_trees)
-                out[cls] = np.asarray(
-                    _jit_forest_raw(stacked, dj), np.float64)
+                # gather-free MXU path for numeric-only forests
+                # (ops/predict.MatmulForest); categorical models keep
+                # the traversal walk
+                mf = stack_trees_matmul(class_trees) if class_trees else None
+                st = stack_trees_raw(class_trees) \
+                    if class_trees and mf is None else None
+                class_stacks.append((mf, st))
+
+        c = self._PREDICT_ROW_CHUNK_MATMUL \
+            if (not use_es and class_stacks
+                and all(mf is not None for mf, _ in class_stacks)) \
+            else self._PREDICT_ROW_CHUNK
+        for i in range(0, n, c):
+            dj = jnp.asarray(data[i:i + c])
+            sl = slice(i, i + dj.shape[0])
+            if use_es:
+                from ..ops.predict import predict_forest_raw_early_stop
+                out[:, sl] = np.asarray(predict_forest_raw_early_stop(
+                    stacked_kt, dj, float(pred_early_stop_margin),
+                    int(pred_early_stop_freq)), np.float64)
+            elif total > 0:
+                for cls, (mf, st) in enumerate(class_stacks):
+                    if mf is not None:
+                        out[cls, sl] = np.asarray(
+                            _jit_forest_raw_matmul(mf, dj), np.float64)
+                    elif st is not None:
+                        out[cls, sl] = np.asarray(
+                            _jit_forest_raw(st, dj), np.float64)
         if self.average_output and total > 0:
             out /= max(total // k, 1)
         out += self.init_score_bias
@@ -828,7 +861,9 @@ class GBDT:
                 pred_early_stop_margin: float = 10.0) -> np.ndarray:
         import jax.numpy as jnp
         if pred_leaf:
-            from ..ops.predict import predict_forest_leaf_raw, stack_trees_raw
+            from ..ops.predict import (predict_forest_leaf_matmul,
+                                       predict_forest_leaf_raw,
+                                       stack_trees_matmul, stack_trees_raw)
             data = np.asarray(data, np.float32)
             k = self.num_tree_per_iteration
             total = len(self.models)
@@ -836,6 +871,10 @@ class GBDT:
                 total = min(total, num_iteration * k)
             if total == 0:
                 return np.zeros((data.shape[0], 0), np.int32)
+            mf = stack_trees_matmul(self.models[:total])
+            if mf is not None:
+                return np.asarray(predict_forest_leaf_matmul(
+                    mf, jnp.asarray(data)))
             stacked = stack_trees_raw(self.models[:total])
             return np.asarray(predict_forest_leaf_raw(
                 stacked, jnp.asarray(data)))
