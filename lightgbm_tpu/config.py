@@ -165,7 +165,10 @@ class TreeConfig:
     gpu_platform_id: int = -1
     gpu_device_id: int = -1
     gpu_use_dp: bool = False
-    tpu_hist_chunk: int = 32768
+    # rows per histogram chunk step; 64k measured ~25% faster than 32k
+    # on narrow shapes (r4, the group-block plan bounds the working set
+    # so the chunk no longer needs to)
+    tpu_hist_chunk: int = 65536
     tpu_double_precision: bool = False
     # speculative-expansion width (learner/grow.py): nodes expanded per
     # histogram pass; 1 = one data pass per split. 12 fills the 128-lane
