@@ -278,13 +278,15 @@ class GBDT:
         # budget/(chunk*B) groups each (ops/histogram.plan_group_blocks),
         # so the row chunk no longer shrinks with G*B (the round-3 scheme
         # collapsed to 512-row chunks at Epsilon-like G*B ~ 128k). Cap the
-        # chunk only enough to keep the unrolled block count ~<= 32 per
-        # pass (measured: Bosch-shape passes run 1.6x faster at 8192-row
-        # chunks/32 blocks than at 4096/16), with a 4096-row floor so
-        # huge G*B widens the plan instead of re-shrinking the chunk.
+        # chunk only enough to keep the unrolled block count ~<= 16 per
+        # pass, with an 8192-row FLOOR: Bosch-shape (G*B ~ 213k) passes
+        # run 1.6x faster at 8192-row chunks than at 4096 even though the
+        # plan widens to ~32 blocks, while Epsilon-shape (G*B ~ 128k)
+        # training collapses 4x if pushed from 8192 to 16384-row chunks —
+        # measured r4 on v5e, so: floor 8192, target 16 blocks.
         gb = max(1, train_data.num_groups * train_data.max_num_bin())
-        target = max(1, (32 << 26) // gb)
-        chunk = min(chunk, max(4096, 1 << int(np.floor(np.log2(target)))))
+        target = max(1, (16 << 26) // gb)
+        chunk = min(chunk, max(8192, 1 << int(np.floor(np.log2(target)))))
         self._chunk = int(min(chunk, max(256, 1 << int(np.ceil(np.log2(max(n, 1)))))))
         row_multiple = self._chunk * (local_dev if nproc > 1 else ndev) \
             if self._tree_learner_kind in ("data", "voting") else self._chunk
