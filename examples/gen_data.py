@@ -22,8 +22,10 @@ def binary(n=7000, f=28, seed=0):
     s = X[:, 0] * 1.2 - X[:, 1] + 0.8 * X[:, 2] * X[:, 3] + 0.5 * np.abs(X[:, 4])
     y = (s + rng.logistic(size=n) > 0.3).astype(int)
     d = os.path.join(HERE, "binary_classification")
-    write(os.path.join(d, "binary.train"), y[:5000], X[:5000])
-    write(os.path.join(d, "binary.test"), y[5000:], X[5000:])
+    os.makedirs(d, exist_ok=True)
+    s = min(5000, int(n * 0.7))
+    write(os.path.join(d, "binary.train"), y[:s], X[:s])
+    write(os.path.join(d, "binary.test"), y[s:], X[s:])
 
 
 def regression(n=7000, f=20, seed=1):
@@ -31,8 +33,10 @@ def regression(n=7000, f=20, seed=1):
     X = rng.randn(n, f)
     y = X[:, 0] * 2 + X[:, 1] ** 2 - X[:, 2] * X[:, 3] + 0.3 * rng.randn(n)
     d = os.path.join(HERE, "regression")
-    write(os.path.join(d, "regression.train"), y[:5000], X[:5000])
-    write(os.path.join(d, "regression.test"), y[5000:], X[5000:])
+    os.makedirs(d, exist_ok=True)
+    s = min(5000, int(n * 0.7))
+    write(os.path.join(d, "regression.train"), y[:s], X[:s])
+    write(os.path.join(d, "regression.test"), y[s:], X[s:])
 
 
 def lambdarank(n_queries=250, seed=2):
@@ -69,8 +73,22 @@ def parallel(seed=3):
     write(os.path.join(d, "binary.train"), y, X)
 
 
+def multiclass(n=7000, f=28, k=5, seed=4):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    centers = rng.randn(k, 6) * 1.5
+    dist = ((X[:, None, :6] - centers[None]) ** 2).sum(-1)
+    y = np.argmin(dist + rng.gumbel(size=(n, k)), axis=1).astype(int)
+    d = os.path.join(HERE, "multiclass_classification")
+    os.makedirs(d, exist_ok=True)
+    s = min(5000, int(n * 0.7))
+    write(os.path.join(d, "multiclass.train"), y[:s], X[:s])
+    write(os.path.join(d, "multiclass.test"), y[s:], X[s:])
+
+
 if __name__ == "__main__":
     binary()
     regression()
     lambdarank()
     parallel()
+    multiclass()

@@ -1,0 +1,51 @@
+"""Advanced API features: weights, init score, continued training,
+JSON dump, importance (reference analogue:
+examples/python-guide/advanced_example.py)."""
+import json
+import os
+
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+BIN = os.path.join(HERE, "..", "binary_classification")
+
+train = np.loadtxt(os.path.join(BIN, "binary.train"), delimiter="\t")
+test = np.loadtxt(os.path.join(BIN, "binary.test"), delimiter="\t")
+y_train, X_train = train[:, 0], train[:, 1:]
+y_test, X_test = test[:, 0], test[:, 1:]
+n = len(y_train)
+
+# per-row weights
+w = np.where(np.arange(n) % 3 == 0, 0.5, 1.0)
+lgb_train = lgb.Dataset(X_train, y_train, weight=w, free_raw_data=False)
+lgb_eval = lgb.Dataset(X_test, y_test, reference=lgb_train)
+
+params = {"boosting_type": "gbdt", "objective": "binary",
+          "metric": "binary_logloss", "num_leaves": 31, "verbose": 0}
+
+evals_result = {}
+gbm = lgb.train(params, lgb_train, num_boost_round=10,
+                valid_sets=[lgb_eval], evals_result=evals_result,
+                verbose_eval=5)
+
+print("Dumping model to JSON...")
+model_json = gbm.dump_model()
+with open(os.path.join(HERE, "model.json"), "w") as fh:
+    json.dump(model_json, fh, indent=2)
+
+print("Feature importances:", list(gbm.feature_importance()))
+
+print("Saving and continuing training from the saved model...")
+path = os.path.join(HERE, "model_adv.txt")
+gbm.save_model(path)
+gbm2 = lgb.train(params, lgb_train, num_boost_round=10,
+                 init_model=path, valid_sets=[lgb_eval],
+                 verbose_eval=False)
+print("Continued model has", gbm2.num_trees(), "trees")
+
+print("Prediction with early stopping:")
+pred = gbm2.predict(X_test, pred_early_stop=True,
+                    pred_early_stop_freq=5, pred_early_stop_margin=4.0)
+print("first 5 predictions:", pred[:5])

@@ -1,0 +1,45 @@
+"""Plotting helpers: metric curves, importance, a single tree
+(reference analogue: examples/python-guide/plot_example.py). Skips
+gracefully when matplotlib is unavailable."""
+import os
+
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+try:
+    import matplotlib  # noqa: F401
+    matplotlib.use("Agg")
+except ImportError:
+    raise SystemExit("matplotlib is not installed; nothing to plot")
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REG = os.path.join(HERE, "..", "regression")
+
+train = np.loadtxt(os.path.join(REG, "regression.train"), delimiter="\t")
+test = np.loadtxt(os.path.join(REG, "regression.test"), delimiter="\t")
+y_train, X_train = train[:, 0], train[:, 1:]
+y_test, X_test = test[:, 0], test[:, 1:]
+
+lgb_train = lgb.Dataset(X_train, y_train)
+lgb_eval = lgb.Dataset(X_test, y_test, reference=lgb_train)
+
+evals_result = {}
+gbm = lgb.train({"objective": "regression", "metric": "l2",
+                 "verbose": 0}, lgb_train, num_boost_round=20,
+                valid_sets=[lgb_train, lgb_eval],
+                valid_names=["train", "valid"],
+                evals_result=evals_result, verbose_eval=False)
+
+print("Plotting metrics during training...")
+ax = lgb.plot_metric(evals_result, metric="l2")
+ax.figure.savefig(os.path.join(HERE, "metric.png"))
+
+print("Plotting feature importances...")
+ax = lgb.plot_importance(gbm, max_num_features=10)
+ax.figure.savefig(os.path.join(HERE, "importance.png"))
+
+print("Plotting the first tree...")
+ax = lgb.plot_tree(gbm, tree_index=0)
+ax.figure.savefig(os.path.join(HERE, "tree.png"))
+print("wrote metric.png importance.png tree.png")

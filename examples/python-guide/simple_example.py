@@ -1,0 +1,42 @@
+"""Basic train -> evaluate -> save -> predict loop on the regression
+example data (reference analogue: examples/python-guide/simple_example.py)."""
+import os
+
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REG = os.path.join(HERE, "..", "regression")
+
+train = np.loadtxt(os.path.join(REG, "regression.train"), delimiter="\t")
+test = np.loadtxt(os.path.join(REG, "regression.test"), delimiter="\t")
+y_train, X_train = train[:, 0], train[:, 1:]
+y_test, X_test = test[:, 0], test[:, 1:]
+
+lgb_train = lgb.Dataset(X_train, y_train)
+lgb_eval = lgb.Dataset(X_test, y_test, reference=lgb_train)
+
+params = {
+    "boosting_type": "gbdt",
+    "objective": "regression",
+    "metric": ["l2", "l1"],
+    "num_leaves": 31,
+    "learning_rate": 0.05,
+    "feature_fraction": 0.9,
+    "bagging_fraction": 0.8,
+    "bagging_freq": 5,
+    "verbose": 0,
+}
+
+print("Starting training...")
+gbm = lgb.train(params, lgb_train, num_boost_round=20,
+                valid_sets=[lgb_eval], early_stopping_rounds=5)
+
+print("Saving model...")
+gbm.save_model(os.path.join(HERE, "model.txt"))
+
+print("Starting predicting...")
+y_pred = gbm.predict(X_test, num_iteration=gbm.best_iteration)
+rmse = float(np.sqrt(np.mean((y_pred - y_test) ** 2)))
+print(f"The RMSE of prediction is: {rmse}")

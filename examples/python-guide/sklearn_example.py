@@ -1,0 +1,38 @@
+"""scikit-learn estimator API + GridSearchCV (reference analogue:
+examples/python-guide/sklearn_example.py)."""
+import os
+
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REG = os.path.join(HERE, "..", "regression")
+
+train = np.loadtxt(os.path.join(REG, "regression.train"), delimiter="\t")
+test = np.loadtxt(os.path.join(REG, "regression.test"), delimiter="\t")
+y_train, X_train = train[:, 0], train[:, 1:]
+y_test, X_test = test[:, 0], test[:, 1:]
+
+print("Starting training...")
+gbm = lgb.LGBMRegressor(objective="regression", num_leaves=31,
+                        learning_rate=0.05, n_estimators=20)
+gbm.fit(X_train, y_train, eval_set=[(X_test, y_test)],
+        eval_metric="l1", early_stopping_rounds=5)
+
+print("Starting predicting...")
+y_pred = gbm.predict(X_test, num_iteration=gbm.best_iteration_)
+rmse = float(np.sqrt(np.mean((y_pred - y_test) ** 2)))
+print(f"The RMSE of prediction is: {rmse}")
+
+print("Feature importances:", list(gbm.feature_importances_))
+
+try:
+    from sklearn.model_selection import GridSearchCV
+    estimator = lgb.LGBMRegressor()
+    param_grid = {"learning_rate": [0.01, 0.1], "n_estimators": [10, 20]}
+    gbm = GridSearchCV(estimator, param_grid, cv=3)
+    gbm.fit(X_train, y_train)
+    print("Best parameters found by grid search are:", gbm.best_params_)
+except ImportError:
+    print("sklearn not available; skipping grid search")

@@ -163,9 +163,27 @@ class Dataset:
                                    if str(dt) == "category"]
         except ImportError:
             pass
-        if isinstance(self.categorical_feature, (list, tuple)):
+        cat_param = self.categorical_feature
+        if cat_param == "auto" and params.get("categorical_column"):
+            # params-passed categorical features (the reference's
+            # categorical_column / categorical_feature parameter,
+            # config.h io section): "0,1,2" or "name:c1,c2" or a list
+            cp = params["categorical_column"]
+            if isinstance(cp, str):
+                if cp.startswith("name:"):
+                    # name:-prefixed entries resolve strictly through the
+                    # feature-name table, even when the names are numeric
+                    # strings (the reference's contract)
+                    cat_param = [c for c in cp[5:].split(",") if c != ""]
+                else:
+                    cat_param = [int(c) for c in cp.split(",") if c != ""]
+            elif isinstance(cp, (int, np.integer)):
+                cat_param = [int(cp)]
+            else:
+                cat_param = list(cp)
+        if isinstance(cat_param, (list, tuple)):
             cat_indices = []
-            for c in self.categorical_feature:
+            for c in cat_param:
                 if isinstance(c, str) and feature_names and c in feature_names:
                     cat_indices.append(feature_names.index(c))
                 elif isinstance(c, int):

@@ -20,6 +20,10 @@ from ..ops.predict import predict_value_binned
 class DART(GBDT):
     def __init__(self, config):
         super().__init__(config)
+        # DART reads back the CURRENT iteration's tree (normalization,
+        # dart.hpp:85-130), so the base class's one-behind async tree
+        # pipeline cannot apply
+        self._supports_pipeline = False
         self.tree_weight: List[float] = []
         self.sum_weight = 0.0
         self._drop_rng = np.random.RandomState(config.boosting.drop_seed)

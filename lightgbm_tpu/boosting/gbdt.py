@@ -26,6 +26,12 @@ from ..tree import Tree
 
 _K_EPSILON = 1e-15
 
+# ceiling for the sibling-subtraction histogram cache ([M, G, B, 3] f32
+# per class tree); beyond it the grower builds both children directly.
+# Deliberately modest: a near-HBM-sized cache (Epsilon-shape at 2 GiB
+# measured) thrashes the while-loop carry and stalls training outright
+_SUBTRACT_CACHE_BUDGET = 256 << 20
+
 
 _forest_raw_jit = None
 _forest_binned_jit = None
@@ -85,7 +91,7 @@ _SMALL_STATE_KEYS = (
     "num_leaves_used", "leaf_value", "count", "node_feature",
     "node_threshold", "node_default_left", "node_is_cat", "node_left",
     "node_right", "node_gain", "node_value", "node_count", "num_passes",
-    "comm_elems")
+    "next_free", "comm_elems")
 
 
 class _HostState:
@@ -235,6 +241,14 @@ class GBDT:
         self.max_feature_idx = 0
         self.feature_names: List[str] = []
         self._eval_history: List[dict] = []
+        self._stopped = False
+        # 1-deep async pipeline (serial learner, no valid sets): the
+        # grower's small tree arrays stay on device until the NEXT
+        # iteration has been dispatched, so the synchronous relay fetch
+        # + host Tree build overlap device compute instead of serializing
+        # with it (measured ~130 ms/iter of pure dispatch/fetch latency
+        # at 500k rows — more than the device time of the iteration)
+        self._pending_small = None
 
     # ------------------------------------------------------------------
     def init(self, train_data: Dataset, objective: Optional[ObjectiveFunction],
@@ -369,11 +383,71 @@ class GBDT:
                 m.init(train_data.metadata, n)
                 self.metrics.append(m)
 
+        use_pallas = (self.config.tree.tpu_hist_pallas
+                      and self._tree_learner_kind == "serial"
+                      and _pallas_available())
+
+        # --- execution-schedule auto-selection ----------------------------
+        # (bit-identical trees for any batch_k; subtraction/compaction only
+        # change f32 summation order). "wide" shapes (large groups*bins)
+        # are channel-cost-bound in the histogram contraction, narrow
+        # shapes are MXU-tile-bound — different best batch widths.
+        L_cfg = self.config.tree.num_leaves
+        g_cnt = max(1, int(train_data.num_groups))
+        # "wide" = the histogram contraction is channel-cost-bound (the
+        # [G*B, chunk] x [chunk, S] matmul's FLOPs scale with S) rather
+        # than tile-bound; Bosch-shape (~22k) measured fastest at narrow
+        # batches, HIGGS/Expo (~2k) at full-tile ones
+        wide = g_cnt * self._max_bins > 8192
+        k_cls = self.num_tree_per_iteration
+        # sibling subtraction: per-node [M, G, B, 3] histogram cache must
+        # fit the budget (vmap'd class trees each carry their own cache).
+        # Node-table size rides the same budget: generous tables keep
+        # late-boosting speculation wide (grow.py table notes) — use the
+        # largest table_mult in [4, 12] whose cache still fits; without
+        # the cache the table is [M]-scalar cheap, so take the max.
+        slot_bytes = k_cls * g_cnt * self._max_bins * 3 * 4
+        mult_fit = int((_SUBTRACT_CACHE_BUDGET // max(slot_bytes, 1) - 52)
+                       // max(L_cfg, 1))
+        subtract = (self.config.tree.tpu_hist_subtract
+                    and self._tree_learner_kind == "serial"
+                    and not use_pallas
+                    # vmap'd class trees each carry a cache: the x k_cls
+                    # scatter/memory traffic measured a net LOSS on the
+                    # multiclass shape (0.62 vs 0.89 Mrow-iters/s)
+                    and k_cls == 1
+                    and mult_fit >= 6)
+        table_mult = min(12, mult_fit) if subtract else 12
+        import os as _os
+        if _os.environ.get("LGBM_TPU_TABLE_MULT"):      # debug override
+            table_mult = int(_os.environ["LGBM_TPU_TABLE_MULT"])
+        if _os.environ.get("LGBM_TPU_FORCE_SUBTRACT"):  # debug override
+            subtract = _os.environ["LGBM_TPU_FORCE_SUBTRACT"] == "1"
+        if "tpu_batch_k" in self.config.raw_params:
+            batch_k = self.config.tree.tpu_batch_k
+        elif subtract:
+            # one smaller-child channel set per node: 25*(3+2) fills the
+            # 128-lane tile; wide shapes stay narrow (channel-cost-bound
+            # passes + depth-bound trees — K=8 matches the channel cost
+            # of the round-4 K=4 direct path while expanding 2x nodes)
+            batch_k = 8 if wide else 24
+        else:
+            # Bosch-class data (wide AND heavily EFB-bundled — sparse
+            # one-hot blocks) measured fastest at K=4: deep depth-bound
+            # trees, channel-cost-bound passes. Unbundled wide shapes
+            # (Epsilon) keep the full-tile default.
+            bundled = g_cnt < 0.8 * max(1, train_data.num_features)
+            batch_k = 4 if (wide and bundled) else 12
+        log.info("Schedule: groups=%d max_bin=%d wide=%s subtract=%s "
+                 "batch_k=%d table_mult=%d chunk=%d", g_cnt, self._max_bins,
+                 wide, subtract, batch_k, table_mult, self._chunk)
         self._grower_cfg = GrowerConfig(
             num_leaves=self.config.tree.num_leaves,
             max_bins=self._max_bins,
             feature_bins=int(train_data.num_bins_per_feature().max(initial=1)),
-            batch_k=self.config.tree.tpu_batch_k,
+            batch_k=batch_k,
+            hist_subtract=subtract,
+            table_mult=table_mult,
             hist_bf16=self.config.tree.tpu_hist_bf16,
             chunk=self._chunk,
             lambda_l1=self.config.tree.lambda_l1,
@@ -387,9 +461,7 @@ class GBDT:
                                  if train_data.groups is not None
                                  and train_data.groups.num_groups
                                  else train_data.num_bins_per_feature())),
-            use_pallas=(self.config.tree.tpu_hist_pallas
-                        and self._tree_learner_kind == "serial"
-                        and _pallas_available()),
+            use_pallas=use_pallas,
         )
 
         # build the distributed grower + finalize the (possibly feature-
@@ -443,6 +515,7 @@ class GBDT:
                   metric_names: Sequence[str] = ()) -> None:
         """Reference: GBDT::AddValidDataset, gbdt.cpp:204-224."""
         import jax.numpy as jnp
+        self.finalize_training()
         self.valid_sets.append(valid_data)
         self.valid_names.append(name)
         ms = []
@@ -484,11 +557,14 @@ class GBDT:
     def _bagging_weights(self, iter_idx: int, grad=None, hess=None):
         """0/1 in-bag weights (reference: GBDT::Bagging, gbdt.cpp:225-286),
         built ON DEVICE: per-row Bernoulli(bagging_fraction) from the jax
-        PRNG keyed by (bagging_seed, refresh index) — the reference's
-        per-block `rand < fraction` scheme without the per-iteration [N]
-        host->device upload. GOSS overrides this using the gradient
-        magnitudes (goss.hpp:87-131). Returns a [n_pad] device array
-        (padding suffix zeroed) or None for no bagging."""
+        PRNG keyed by (bagging_seed, refresh index). DEVIATION from the
+        reference: its BaggingHelper adapts probabilities within each
+        block to guarantee an exact in-bag count (CHECK(cur_left_cnt ==
+        bag_data_cnt)); plain Bernoulli sampling makes the in-bag count
+        binomially distributed around n*fraction instead (see PARITY.md).
+        GOSS overrides this using the gradient magnitudes
+        (goss.hpp:87-131). Returns a [n_pad] device array (padding
+        suffix zeroed) or None for no bagging."""
         bf = self.config.boosting.bagging_fraction
         freq = self.config.boosting.bagging_freq
         if bf >= 1.0 or freq <= 0:
@@ -613,6 +689,18 @@ class GBDT:
         if k > 1 and self._dist_grower is None:
             return self._train_one_iter_multi(grad, hess, row_weight)
 
+        import os
+        if (self._dist_grower is None and k == 1 and not self.valid_sets
+                and gradients is None
+                and getattr(self, "_supports_pipeline", True)
+                and not os.environ.get("LGBM_TPU_NO_PIPELINE")):
+            return self._train_one_iter_pipelined(grad, hess, row_weight)
+
+        # leaving the pipelined path (explicit gradients, a valid set
+        # added mid-training, ...): drain the pending tree FIRST so
+        # models stay in iteration order
+        self._flush_pending()
+
         could_split_any = False
         for cls in range(k):
             mask = self._feature_mask()
@@ -671,6 +759,99 @@ class GBDT:
 
         return self._finish_iter(could_split_any)
 
+    def _train_one_iter_pipelined(self, grad, hess, row_weight) -> bool:
+        """Serial-learner iteration with the tree fetch pipelined one
+        iteration behind the device dispatch (see __init__ note). The
+        stop/rollback decision therefore lags one iteration: a
+        non-splitting tree is detected when it is materialized, its
+        iteration is rolled back (its score delta was already zero on
+        device, _grow_and_update_impl's `grew` guard), and the one extra
+        dispatched iteration — which cannot split either — is discarded
+        by finalize_training()."""
+        import jax.numpy as jnp
+
+        from .. import tracing
+        from ..learner.grow import FMETA_KEYS
+
+        if getattr(self, "_stopped", False):
+            return True
+        mask = self._feature_mask()
+        with tracing.phase("tree/grow"):
+            self._score, small = _grow_and_update(
+                self._score, self._binned, grad[0], hess[0],
+                row_weight, jnp.asarray(mask), self.shrinkage_rate,
+                self._n, [self._fmeta[key] for key in FMETA_KEYS], 0,
+                self._grower_cfg)
+        # fetch + build the PREVIOUS tree while this one runs on device
+        ok_prev = self._flush_pending()
+        # stash the DISPATCH-TIME shrinkage: a learning-rate schedule
+        # (reset_parameter callback) changes self.shrinkage_rate before
+        # the flush happens one iteration later
+        self._pending_small = (small, self.shrinkage_rate)
+        self.iter_ += 1
+        if not ok_prev:
+            # previous iteration produced no split: unwind the
+            # speculative iteration just dispatched. Under bagging it may
+            # HAVE split (a fresh bag can open splits the previous one
+            # closed) and its leaf values are already in the device
+            # score, so roll it back the way rollback_one_iter does —
+            # materialize and subtract its traversal values — instead of
+            # assuming the delta was zero.
+            small, shrink = self._pending_small
+            self._pending_small = None
+            self.iter_ -= 1
+            import jax
+            host_state = _HostState(jax.device_get(small))
+            tree = Tree.from_grower_state(host_state, self.train_data)
+            if tree.num_leaves > 1:
+                tree.apply_shrinkage(shrink)
+                neg = copy.deepcopy(tree)
+                neg.leaf_value = -neg.leaf_value
+                self._score = self._score.at[0].add(
+                    predict_value_binned(neg.to_device(), self._binned))
+            return True
+        return False
+
+    def _flush_pending(self) -> bool:
+        """Materialize the pipelined tree, if any. Returns False when the
+        tree could not split (its iteration is rolled back here)."""
+        if self._pending_small is None:
+            return True
+        small, shrink = self._pending_small
+        self._pending_small = None
+        import jax
+
+        from .. import tracing
+        with tracing.phase("tree/extract"):
+            host_state = _HostState(jax.device_get(small))
+            tree = Tree.from_grower_state(host_state, self.train_data)
+        # schedule observability (scripts/profile_train.py + PARITY.md)
+        if not hasattr(self, "pass_log"):
+            self.pass_log = []
+        self.pass_log.append((int(host_state.num_passes),
+                              int(host_state.next_free)))
+        if tree.num_leaves > 1:
+            tree.apply_shrinkage(shrink)
+            if abs(getattr(self, "_pending_bias", 0.0)) > _K_EPSILON:
+                tree.add_bias(self._pending_bias)
+                self._pending_bias = 0.0
+                self.init_score_bias = 0.0
+            self.models.append(tree)
+            return True
+        self.iter_ -= 1
+        # latch the stop so a drain from finalize_training (e.g. a
+        # training-metric eval mid-loop) cannot swallow it — the next
+        # train_one_iter must still report termination
+        self._stopped = True
+        log.warning("Stopped training because there are no more leaves "
+                    "that meet the split requirements")
+        return False
+
+    def finalize_training(self) -> None:
+        """Drain the async pipeline (engine.train calls this after the
+        boosting loop; model/prediction readers call it defensively)."""
+        self._flush_pending()
+
     def _update_valid_scores(self, cls: int, tree) -> None:
         from .. import tracing
         with tracing.phase("boosting/update_valid_score"):
@@ -728,6 +909,8 @@ class GBDT:
     def rollback_one_iter(self) -> None:
         """Reference: GBDT::RollbackOneIter, gbdt.cpp:476-492."""
         import jax.numpy as jnp
+        self.finalize_training()
+        self._stopped = False
         if self.iter_ <= 0:
             return
         k = self.num_tree_per_iteration
@@ -750,6 +933,7 @@ class GBDT:
         is_bigger_better) tuples (reference: GBDT::OutputMetric,
         gbdt.cpp:575-632)."""
         out = []
+        self.finalize_training()
         if self.metrics and self.config.metric.is_provide_training_metric:
             train_score = self._train_score_unpadded()
             for m in self.metrics:
@@ -768,6 +952,7 @@ class GBDT:
 
     # ------------------------------------------------------------------
     def num_trees(self) -> int:
+        self.finalize_training()
         return len(self.models)
 
     def current_iteration(self) -> int:
@@ -797,6 +982,7 @@ class GBDT:
         import jax
         import jax.numpy as jnp
         data = np.asarray(data, np.float32)
+        self.finalize_training()
         n = data.shape[0]
         k = self.num_tree_per_iteration
         total = len(self.models)
@@ -823,9 +1009,9 @@ class GBDT:
             from ..ops.predict import stack_trees_matmul, stack_trees_raw
             for cls in range(k):
                 class_trees = [self.models[i] for i in range(cls, total, k)]
-                # gather-free MXU path for numeric-only forests
-                # (ops/predict.MatmulForest); categorical models keep
-                # the traversal walk
+                # gather-free MXU path (ops/predict.MatmulForest),
+                # including categorical models via the one-hot category
+                # expansion; only over-budget forests take the walk
                 mf = stack_trees_matmul(class_trees) if class_trees else None
                 st = stack_trees_raw(class_trees) \
                     if class_trees and mf is None else None
@@ -863,6 +1049,7 @@ class GBDT:
                 pred_early_stop_freq: int = 10,
                 pred_early_stop_margin: float = 10.0) -> np.ndarray:
         import jax.numpy as jnp
+        self.finalize_training()
         if pred_leaf:
             from ..ops.predict import (predict_forest_leaf_matmul,
                                        predict_forest_leaf_raw,
@@ -903,6 +1090,7 @@ class GBDT:
         return "tree"
 
     def save_model_to_string(self, num_iteration: int = -1) -> str:
+        self.finalize_training()
         out = [self.model_name()]
         out.append("version=v2_tpu")
         out.append(f"num_class={self.num_class}")
@@ -985,6 +1173,7 @@ class GBDT:
     def feature_importance(self, importance_type: str = "split",
                            num_iteration: int = -1) -> np.ndarray:
         """Reference: GBDT::FeatureImportance (gbdt_model.cpp:335-370)."""
+        self.finalize_training()
         nf = self.max_feature_idx + 1
         imp = np.zeros(nf, np.float64)
         total = len(self.models)
@@ -1001,6 +1190,7 @@ class GBDT:
         return imp
 
     def dump_model(self, num_iteration: int = -1) -> dict:
+        self.finalize_training()
         total = len(self.models)
         if num_iteration > 0:
             total = min(total, num_iteration * self.num_tree_per_iteration)

@@ -176,6 +176,12 @@ class TreeConfig:
     tpu_batch_k: int = 12
     # bf16 hi+lo MXU histogram contraction (ops/histogram.py)
     tpu_hist_bf16: bool = True
+    # sibling subtraction via a per-node histogram cache (the reference
+    # HistogramPool + FeatureHistogram::Subtract economics,
+    # feature_histogram.hpp:64-70,380-548): build only the smaller
+    # child's histogram per expansion. Auto-disabled when the cache
+    # would exceed its device-memory budget (boosting/gbdt.py).
+    tpu_hist_subtract: bool = True
     # opt-in fused pallas histogram kernel (ops/hist_pallas.py). Off by
     # default: measured on v5e, XLA's own fusion of the one-hot compare
     # into the dot already matches it (11.1 vs 14.4 ms/pass at 2M x 28

@@ -120,6 +120,10 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
                 break
     except KeyboardInterrupt:
         raise
+    finally:
+        # drain the async tree pipeline (boosting/gbdt.py) so models are
+        # materialized before anyone reads booster internals
+        booster._inner.finalize_training()
     return booster
 
 

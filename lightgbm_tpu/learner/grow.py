@@ -25,7 +25,11 @@ children histograms needs only the leaf's CACHED best split — not its
 commit. So the grower speculatively expands the gain-priority frontier
 down the tree, decoupled from the commit order:
 
-- a NODE TABLE of M = 4L + 2K + 2 slots holds every speculative node:
+- a NODE TABLE of M = 6L + 2K + 2 slots holds every speculative node
+  (a grown tree consumes ~2L slots for commits plus ~2L for the
+  speculatively-expanded end frontier; 6L leaves mis-speculation
+  headroom — at 4L the table exhausted mid-tree once late-boosting
+  gains flattened and passes degraded to one forced expansion each):
   parent link, depth, aggregate (g, h, count), its cached best split,
   and lifecycle bits (created/expanded/committed/frontier);
 - `leaf_id[N]` labels rows with the DEEPEST speculative node that owns
@@ -33,9 +37,12 @@ down the tree, decoupled from the commit order:
   nodes under their cached splits and relabels them to fresh child ids —
   children histograms are then direct `leaf_id == child` masked
   reductions (ops/histogram.batched_leaves_histogram);
-- selection is top-K by cached gain among unexpanded nodes, with the
-  commit-blocking frontier argmax force-included, so the strict order
-  can always make progress;
+- selection is top-K by cached gain among unexpanded nodes — throttled
+  to the nodes whose gain ranks within the remaining commit budget (see
+  expand()), since slots spent on never-committed expansions exhaust
+  the table when late-boosting gains flatten — with the commit-blocking
+  frontier argmax force-included, so the strict order can always make
+  progress;
 - COMMITS touch only [M]/[L]-sized state: pop the frontier argmax,
   write the tree node, promote the (already created) children to the
   frontier. No data pass, no row updates. Trees are therefore
@@ -44,9 +51,17 @@ down the tree, decoupled from the commit order:
   reference's HistogramPool gives: a pure cache never changes the tree,
   feature_histogram.hpp:380-548).
 
+Sibling subtraction (round 5, `hist_subtract`): a [M, G, B, 3] cache
+retains every created node's histogram (the HistogramPool,
+feature_histogram.hpp:380-548); each expansion contracts only the
+SMALLER child per node and derives the larger as parent - smaller
+(FeatureHistogram::Subtract, feature_histogram.hpp:64-70). Channels per
+node halve, so batch_k doubles inside the same 128-lane MXU output tile
+(K*(3+2) <= 128 -> K <= 25).
+
 Pass count drops from ~(commits / 2.8) to ~max(tree depth, commits / K):
-measured 91 -> ~30 per 255-leaf tree, with each pass's 2K*(3+2) output
-channels sized to fill the 128-lane MXU tile (batch_k=12 default).
+measured 91 -> ~30 per 255-leaf tree (batch_k=12, round 3), ~20 with
+subtraction's batch_k=24.
 
 `num_leaves-1` commits, one compile per (N, F, B, L, hyperparam)
 signature, reused across trees and boosting iterations.
@@ -122,6 +137,20 @@ class GrowerConfig(NamedTuple):
     # fused pallas histogram kernel (ops/hist_pallas.py) — TPU serial
     # learner only; the GBDT layer sets this from backend + config
     use_pallas: bool = False
+    # sibling subtraction (reference: FeatureHistogram::Subtract,
+    # feature_histogram.hpp:64-70, retained by the HistogramPool,
+    # feature_histogram.hpp:380-548): keep every speculative node's group
+    # histogram in a [M, G, B, 3] cache, build only the SMALLER child's
+    # histogram per expanded node and derive the larger as
+    # parent - smaller. Halves the contraction channels per node, so
+    # batch_k can double inside the same 128-lane MXU output tile.
+    # The GBDT layer gates this on the cache fitting a memory budget.
+    hist_subtract: bool = False
+    # node-table slots per num_leaves (M = table_mult*L + 2K + 2). The
+    # GBDT layer raises this as far as the subtraction cache's memory
+    # budget allows: generous tables keep late-boosting (flat-gain)
+    # speculation wide — see the table-exhaustion notes in expand().
+    table_mult: int = 6
 
 
 class TreeGrowerState(NamedTuple):
@@ -135,6 +164,8 @@ class TreeGrowerState(NamedTuple):
     leaf_depth: jnp.ndarray
     leaf_parent: jnp.ndarray
     num_passes: jnp.ndarray       # scalar i32: data passes this tree
+    next_free: jnp.ndarray        # scalar i32: node-table high-water mark
+                                  # (speculation-waste observability)
     comm_elems: jnp.ndarray       # scalar f32: elements moved through
                                   # cross-shard collectives this tree
     # tree node arrays [L-1]
@@ -151,7 +182,7 @@ class TreeGrowerState(NamedTuple):
 
 
 class _NodeTable(NamedTuple):
-    """Speculative node table, all arrays [M] (M = 4L + 2K + 2; slot M-1
+    """Speculative node table, all arrays [M] (M = 6L + 2K + 2; slot M-1
     is never allocated — out-of-range scatter indices use mode='drop')."""
     parent: jnp.ndarray           # i32
     depth: jnp.ndarray            # i32
@@ -384,6 +415,10 @@ def _voting_children_best(hists_local, sum_g, sum_h, count, depth,
 # module import must not touch the XLA backend — multihost workers call
 # jax.distributed.initialize() after importing this package.
 _GAIN_CLAMP = 1e30
+# added to eligible frontier nodes' selection scores (expand()): gains are
+# clamped to _GAIN_CLAMP, so + 2e30 strictly dominates any spec node while
+# staying far below the +inf forced-include sentinel
+_FRONTIER_BOOST = 2e30
 
 
 class _Carry(NamedTuple):
@@ -392,6 +427,9 @@ class _Carry(NamedTuple):
     next_free: jnp.ndarray        # scalar i32 allocation pointer
     num_passes: jnp.ndarray
     comm_elems: jnp.ndarray
+    # [M, G, B, 3] per-node group histograms (hist_subtract only; [0]
+    # placeholder otherwise) — the HistogramPool analogue
+    hist_cache: jnp.ndarray
     # committed-tree output state (slot-indexed), as TreeGrowerState
     sum_g: jnp.ndarray
     sum_h: jnp.ndarray
@@ -443,7 +481,7 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     L = cfg.num_leaves
     B = cfg.max_bins
     K = max(1, min(cfg.batch_k, L))
-    M = 4 * L + 2 * K + 2
+    M = max(4, cfg.table_mult) * L + 2 * K + 2
     fmeta = {"num_bin": fmeta_num_bin, "missing_type": fmeta_missing,
              "default_bin": fmeta_default_bin, "is_categorical": fmeta_is_cat,
              "group": fmeta_group, "offset": fmeta_offset,
@@ -503,6 +541,11 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     # learners keep the portable XLA kernels under shard_map)
     pallas_on = (cfg.use_pallas and cfg.hist_bf16
                  and cfg.data_axis is None and cfg.feature_axis is None)
+    # sibling subtraction: voting keeps LOCAL histograms (the cache would
+    # have to be local too and the elected-slice exchange breaks the
+    # parent-minus-child identity), and the pallas kernels have their own
+    # channel packing — both keep the direct 2K-children path
+    subtract = cfg.hist_subtract and not voting and not pallas_on
 
     # --- root (BeforeTrain: serial_tree_learner.cpp:234-323) ------------
     if pallas_on:
@@ -556,6 +599,11 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         leaf_slot=table.leaf_slot.at[0].set(0),
     )
 
+    if subtract:
+        hist_cache = jnp.zeros((M, fl, B, 3), jnp.float32).at[0].set(root_hist)
+    else:
+        hist_cache = jnp.zeros((1,), jnp.float32)
+
     neg_inf = jnp.float32(-jnp.inf)
     carry = _Carry(
         leaf_id=jnp.zeros(n, jnp.int32),
@@ -563,6 +611,7 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         next_free=jnp.int32(1),
         num_passes=jnp.int32(1),
         comm_elems=root_comm,
+        hist_cache=hist_cache,
         sum_g=jnp.zeros(L, jnp.float32).at[0].set(root_g),
         sum_h=jnp.zeros(L, jnp.float32).at[0].set(root_h),
         count=jnp.zeros(L, jnp.float32).at[0].set(root_c),
@@ -589,9 +638,50 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         contraction, scan the children's best splits into the table."""
         t = carry.table
         eligible = t.created & ~t.expanded & (t.gain > 0.0)
+        # budget-aware speculation throttle: the tree has R = L - used
+        # commits left, so only nodes whose gain ranks within the top R
+        # of the current commit-candidate pool (frontier nodes + created
+        # unexpanded spec nodes) are worth slots. Without this, every
+        # eventual LEAF with positive gain attracts one speculative
+        # expansion that never commits (~2L wasted slots late in
+        # boosting, when gains flatten), the table hits its capacity
+        # reserve, and passes degrade to one forced expansion per commit
+        # (measured: 18 -> 145 passes/tree by iteration 100 at 2M rows).
+        # Like any selection policy this only changes WHICH precompute
+        # happens early — commits stay bit-identical.
+        # rank-count formulation: a node passes iff fewer than R pool
+        # gains strictly beat it (ties all pass — harmless slack) — an
+        # [M, M] compare, ~1M bool ops.
+        R = L - carry.num_leaves_used
+        pool = t.created & (t.gain > 0.0) & (t.frontier | ~t.expanded)
+        pg = jnp.where(pool, t.gain, neg_inf)
+        rank = jnp.sum((pg[None, :] > t.gain[:, None]).astype(jnp.int32),
+                       axis=1)                                # [M]
         f_gain = jnp.where(t.frontier, t.gain, neg_inf)
         f_arg = jnp.argmax(f_gain).astype(jnp.int32)
+        # the commit-blocking frontier argmax is EXEMPT from the
+        # throttle: deep spec nodes elsewhere can out-rank every
+        # frontier gain, and throttling the argmax would deadlock the
+        # commit chain — the expansion loop then spins without progress
+        # until the device watchdog kills the worker (observed as a
+        # mid-run "TPU worker crashed" at 2M rows, iteration ~50+).
+        eligible = eligible & ((rank < R)
+                               | (jnp.arange(M, dtype=jnp.int32) == f_arg))
+        # frontier-first selection: unexpanded FRONTIER nodes are the
+        # commit chain's immediate blockers — every one expanded this
+        # pass is a commit the next drain can pop — so they outrank
+        # deeper speculative nodes regardless of raw gain (late-boosting
+        # flat gains otherwise spend the batch on spec descendants while
+        # the drain stalls one forced expansion per round). Selection
+        # policy only: commits stay bit-identical.
         score = jnp.where(eligible, t.gain, neg_inf)
+        if K >= 12:
+            # wide batches only: narrow batches (wide-shape configs,
+            # K<=8) serve depth-bound trees where the deep chain — not
+            # frontier breadth — is the scarce resource (Bosch-shape
+            # measured slower with the boost)
+            score = jnp.where(eligible & t.frontier,
+                              score + _FRONTIER_BOOST, score)
         score = score.at[f_arg].set(
             jnp.where(eligible[f_arg], jnp.inf, score[f_arg]))
         top_gain, sel = jax.lax.top_k(score, K)
@@ -610,61 +700,89 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         cr = cl + 1
         reserve = 2 * (L - carry.num_leaves_used)
         is_forced = eligible[f_arg] & (sel == f_arg)
+        # (measured dead end, kept as a note: tying cumulative slot
+        # spend to commit progress — e.g. 4 slots per committed leaf —
+        # bounds the table mathematically but chokes the broad
+        # speculation that flat-gain trees NEED to keep commits batched:
+        # passes got WORSE, 105 -> 147 at iterations 100+. Generous
+        # tables beat tight budgets here.)
         valid = valid & jnp.where(is_forced, cr < M, cr + reserve < M)
         cl_eff = jnp.where(valid, cl, M)
         cr_eff = jnp.where(valid, cr, M)
         sel_eff = jnp.where(valid, sel, M)
         next_free = carry.next_free + 2 * jnp.sum(valid.astype(jnp.int32))
 
-        # route + relabel the selected nodes' rows (replaces
-        # DataPartition::Split, data_partition.hpp:94-170): each split
-        # descriptor is a handful of SCALARS and the feature's bin column
-        # is ONE contiguous dynamic slice of the transposed bin matrix —
-        # no [N]-indexed gathers anywhere
-        leaf_id = carry.leaf_id
-        for k in range(K):
-            m_k = jnp.clip(sel[k], 0, M - 1)
-            feat = t.feature[m_k]
-            grp = fmeta["group"][feat]
-            off = fmeta["offset"][feat]
-            nb = fmeta["num_bin"][feat]
-            dbin = fmeta["default_bin"][feat]
-            missing = fmeta["missing_type"][feat]
-            col = jax.lax.dynamic_slice(
-                binned_T, (grp, 0), (1, n))[0].astype(jnp.int32)
-            # EFB decode (efb.py): inside the feature's bundle slice the
-            # group bin is offset+bin; anywhere else the row sits at the
-            # default bin
-            in_slice = (col >= off) & (col < off + nb)
-            decoded = jnp.where(in_slice, col - off, dbin)
-            col = jnp.where(fmeta["is_bundled"][feat], decoded, col)
-            thr = t.threshold[m_k]
-            dl = t.default_left[m_k]
-            cat = t.is_cat[m_k]
-            nan_bin = nb - 1
-            is_missing = (((missing == MISSING_NAN) & (col == nan_bin))
-                          | ((missing == MISSING_ZERO) & (col == dbin)))
-            go_left = jnp.where(cat, col == thr,
-                                jnp.where(is_missing, dl, col <= thr))
-            in_k = valid[k] & (leaf_id == sel[k])
-            leaf_id = jnp.where(in_k, jnp.where(go_left, cl[k], cr[k]),
-                                leaf_id)
+        # histogram ids: direct mode builds BOTH children; subtraction
+        # mode builds only each node's SMALLER child (the larger comes
+        # from parent - smaller below, feature_histogram.hpp:64-70)
+        sel_c = jnp.clip(sel, 0, M - 1)
+        if subtract:
+            small_left = t.left_c[sel_c] * 2.0 <= t.count[sel_c]  # [K]
+            hist_ids = jnp.where(valid,
+                                 jnp.where(small_left, cl, cr), -1)
+        else:
+            hist_ids = jnp.concatenate([jnp.where(valid, cl, -1),
+                                        jnp.where(valid, cr, -1)])
 
-        ids2k = jnp.concatenate([jnp.where(valid, cl, -1),
-                                 jnp.where(valid, cr, -1)])
+        def route(lid, col_of_group):
+            """Apply the K selected splits to a leaf-label vector
+            (replaces DataPartition::Split, data_partition.hpp:94-170):
+            each split descriptor is a handful of SCALARS and the
+            feature's bin column is ONE contiguous dynamic slice of the
+            transposed bin matrix — no [N]-indexed gathers anywhere."""
+            for k in range(K):
+                m_k = jnp.clip(sel[k], 0, M - 1)
+                feat = t.feature[m_k]
+                grp = fmeta["group"][feat]
+                off = fmeta["offset"][feat]
+                nb = fmeta["num_bin"][feat]
+                dbin = fmeta["default_bin"][feat]
+                missing = fmeta["missing_type"][feat]
+                col = col_of_group(grp).astype(jnp.int32)
+                # EFB decode (efb.py): inside the feature's bundle slice
+                # the group bin is offset+bin; anywhere else the row sits
+                # at the default bin
+                in_slice = (col >= off) & (col < off + nb)
+                decoded = jnp.where(in_slice, col - off, dbin)
+                col = jnp.where(fmeta["is_bundled"][feat], decoded, col)
+                thr = t.threshold[m_k]
+                dl = t.default_left[m_k]
+                cat = t.is_cat[m_k]
+                nan_bin = nb - 1
+                is_missing = (((missing == MISSING_NAN) & (col == nan_bin))
+                              | ((missing == MISSING_ZERO) & (col == dbin)))
+                go_left = jnp.where(cat, col == thr,
+                                    jnp.where(is_missing, dl, col <= thr))
+                in_k = valid[k] & (lid == sel[k])
+                lid = jnp.where(in_k, jnp.where(go_left, cl[k], cr[k]),
+                                lid)
+            return lid
+
+        leaf_id = route(carry.leaf_id, lambda grp: jax.lax.dynamic_slice(
+            binned_T, (grp, 0), (1, n))[0])
         if pallas_on:
             from ..ops import hist_pallas
             hists = hist_pallas.batched_leaves_histogram_tpu(
-                binned_T, w3, leaf_id, ids2k, B, cfg.chunk,
+                binned_T, w3, leaf_id, hist_ids, B, cfg.chunk,
                 n_valid=nv_local, group_widths=gw)
         else:
             hists = reduce_hist(hist_ops.batched_leaves_histogram(
-                local_binned, w3, leaf_id, ids2k, B, cfg.chunk,
+                local_binned, w3, leaf_id, hist_ids, B, cfg.chunk,
                 bf16=cfg.hist_bf16, n_valid=nv_local,
-                group_widths=gw))                            # [2K, fl, B, 3]
+                group_widths=gw))
+
+        if subtract:
+            # larger child = parent - smaller (the cache holds every
+            # created node's histogram; parents are always present)
+            parent_h = carry.hist_cache[sel_c]               # [K, fl, B, 3]
+            other = parent_h - hists
+            sl4 = small_left[:, None, None, None]
+            hists = jnp.concatenate([jnp.where(sl4, hists, other),
+                                     jnp.where(sl4, other, hists)])
+            # [2K, fl, B, 3] — same (left-block, right-block) layout as
+            # the direct path from here on
 
         # children aggregates from the parents' cached split stats
-        sel_c = jnp.clip(sel, 0, M - 1)
         pg, ph, pc = t.sum_g[sel_c], t.sum_h[sel_c], t.count[sel_c]
         lg, lh = t.left_g[sel_c], t.left_h[sel_c]
         lcc = t.left_c[sel_c]
@@ -690,6 +808,10 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
 
         idx = jnp.concatenate([cl_eff, cr_eff])              # [2K], M = drop
         par2 = jnp.concatenate([sel_eff, sel_eff])
+        hist_cache = carry.hist_cache
+        if subtract:
+            # children become candidate parents: retain their histograms
+            hist_cache = hist_cache.at[idx].set(hists, mode="drop")
         t = t._replace(
             parent=t.parent.at[idx].set(par2, mode="drop"),
             depth=t.depth.at[idx].set(all_d, mode="drop"),
@@ -712,7 +834,8 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         return carry._replace(
             leaf_id=leaf_id, table=t, next_free=next_free,
             num_passes=carry.num_passes + 1,
-            comm_elems=carry.comm_elems + comm)
+            comm_elems=carry.comm_elems + comm,
+            hist_cache=hist_cache)
 
     # --- commit (Train: serial_tree_learner.cpp:152-205) ----------------
     # strict best-first: pop the frontier argmax, write the tree node,
@@ -807,11 +930,15 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         t = carry.table
         f_gain = jnp.where(t.frontier, t.gain, neg_inf)
         growing = (carry.num_leaves_used < L) & (jnp.max(f_gain) > 0.0)
-        # safety net only: the reservation rule in expand() guarantees the
-        # blocking argmax always has room, so this guard cannot trip
+        # safety nets only: the reservation rule in expand() guarantees
+        # the blocking argmax always has room (progress), and a tree can
+        # never need more rounds than commits (each round commits >= 1
+        # via the forced expansion) — the hard cap turns any future
+        # no-progress bug into a truncated tree instead of an infinite
+        # device loop that gets the TPU worker killed.
         f_arg = jnp.argmax(f_gain)
         progress = t.expanded[f_arg] | (carry.next_free + 1 < M)
-        return growing & progress
+        return growing & progress & (carry.num_passes < 4 * L + 64)
 
     carry = jax.lax.while_loop(round_cond, round_body, carry)
 
@@ -842,7 +969,8 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         sum_g=carry.sum_g, sum_h=carry.sum_h, count=carry.count,
         leaf_value=carry.leaf_value, leaf_depth=carry.leaf_depth,
         leaf_parent=carry.leaf_parent,
-        num_passes=carry.num_passes, comm_elems=carry.comm_elems,
+        num_passes=carry.num_passes, next_free=carry.next_free,
+        comm_elems=carry.comm_elems,
         node_feature=carry.node_feature,
         node_threshold=carry.node_threshold,
         node_default_left=carry.node_default_left,

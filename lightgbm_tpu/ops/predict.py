@@ -269,9 +269,16 @@ class MatmulForest(NamedTuple):
                                          in the f32 accumulator
       value[r]   = match @ leaf_value
 
-    Categorical splits need per-row bitset lookups (gathers), so models
-    with any categorical node keep the walk path (stack_trees_matmul
-    returns None and callers fall back).
+    Categorical splits (tree.h:355-359 bitsets) ride the MXU too: the
+    categorical columns are one-hot expanded into a [N, V] block matrix
+    (block = one feature's category range, the layout the reference's
+    users build by hand for Expo) and each tree carries a [V, M] table
+    with +-1 in (category, node) cells of the node's feature block
+    (+1 = in the node's bitset). `expanded @ table` then lands exactly
+    one +-1 per (row, categorical node); a 0 means NaN / out-of-range
+    category, which resolves to "go right" — the same contract as
+    _decide_raw. Forests whose category expansion exceeds _CAT_V_BUDGET
+    keep the walk path.
     """
     feat: jnp.ndarray           # [T, M] i32 original-column index
     threshold: jnp.ndarray      # [T, M] f32
@@ -280,18 +287,58 @@ class MatmulForest(NamedTuple):
     path: jnp.ndarray           # [T, M, L] f32 in {-1, 0, +1}
     leaf_depth: jnp.ndarray     # [T, L] f32 (-1 for padding leaves)
     leaf_value: jnp.ndarray     # [T, L] f32
+    is_cat: jnp.ndarray         # [T, M] bool
+    cat_table: jnp.ndarray      # [T, V, M] f32 in {-1, 0, +1}
+    # forest-level expansion spec [Fc] (NOT per-tree; excluded from
+    # _tree_batches' per-tree reshape and from the scan xs)
+    cat_cols: jnp.ndarray       # [Fc] i32 original column
+    cat_off: jnp.ndarray        # [Fc] i32 block offset into V
+    cat_card: jnp.ndarray       # [Fc] i32 block width
+
+
+# ceiling on the dense [T, M, L] path tensor (elements). Beyond this the
+# MatmulForest layout stops paying for itself: at num_leaves=4095 a few
+# hundred trees would materialize tens of GB on device, so callers fall
+# back to the walk path instead.
+_MATMUL_PATH_BUDGET = 1 << 28
+# ceilings for the categorical extension: total one-hot expansion width
+# and the [T, V, M] table
+_CAT_V_BUDGET = 4096
+_CAT_TABLE_BUDGET = 1 << 28
 
 
 def stack_trees_matmul(trees):
-    """Build the MatmulForest layout, or None if any tree has a
-    categorical split (callers then use the walk path)."""
+    """Build the MatmulForest layout, or None if the [T, M, L] path
+    tensor / categorical expansion would exceed the device-memory
+    budgets (callers then use the walk path)."""
     import numpy as np
-    if any(t.is_categorical_node(i) for t in trees
-           for i in range(max(t.num_leaves - 1, 0))):
-        return None
     max_m = max(max(t.num_leaves - 1, 1) for t in trees)
     max_l = max(t.num_leaves for t in trees)
     T = len(trees)
+    if T * max_m * max_l > _MATMUL_PATH_BUDGET:
+        return None
+
+    # categorical expansion layout: per categorical FEATURE, a block wide
+    # enough for every bitset that splits on it (words * 32 bits)
+    cards = {}
+    for t in trees:
+        for i in range(max(t.num_leaves - 1, 0)):
+            if not t.is_categorical_node(i):
+                continue
+            f = int(t.split_feature[i])
+            ci = int(t.threshold[i])
+            words = int(t.cat_boundaries[ci + 1] - t.cat_boundaries[ci])
+            cards[f] = max(cards.get(f, 0), words * 32)
+    cat_cols = sorted(cards)
+    v_total = sum(cards[f] for f in cat_cols)
+    if v_total > _CAT_V_BUDGET or T * v_total * max_m > _CAT_TABLE_BUDGET:
+        return None
+    offs = {}
+    off = 0
+    for f in cat_cols:
+        offs[f] = off
+        off += cards[f]
+
     fmax = np.finfo(np.float32).max
     feat = np.zeros((T, max_m), np.int32)
     thr = np.zeros((T, max_m), np.float32)
@@ -300,6 +347,8 @@ def stack_trees_matmul(trees):
     path = np.zeros((T, max_m, max_l), np.float32)
     depth = np.full((T, max_l), -1.0, np.float32)
     lval = np.zeros((T, max_l), np.float32)
+    is_cat = np.zeros((T, max_m), bool)
+    cat_table = np.zeros((T, v_total, max_m), np.float32)
 
     for t_i, t in enumerate(trees):
         m = max(t.num_leaves - 1, 0)
@@ -308,6 +357,21 @@ def stack_trees_matmul(trees):
         dleft[t_i, :m] = [t.default_left_node(i) for i in range(m)]
         miss[t_i, :m] = t.node_missing[:m]
         lval[t_i, :t.num_leaves] = t.leaf_value
+        for i in range(m):
+            if not t.is_categorical_node(i):
+                continue
+            is_cat[t_i, i] = True
+            f = int(t.split_feature[i])
+            ci = int(t.threshold[i])
+            lo, hi = int(t.cat_boundaries[ci]), int(t.cat_boundaries[ci + 1])
+            words = np.asarray(t.cat_threshold[lo:hi], np.uint32)
+            bits = np.unpackbits(
+                words.view(np.uint8), bitorder="little")      # [words*32]
+            col = np.where(bits > 0, 1.0, -1.0)
+            blk = offs[f]
+            cat_table[t_i, blk:blk + len(col), i] = col
+            # block tail beyond this node's bitset: not in set -> right
+            cat_table[t_i, blk + len(col):blk + cards[f], i] = -1.0
 
         # DFS from the root accumulating the ancestor signature
         if t.num_leaves == 1:
@@ -330,13 +394,49 @@ def stack_trees_matmul(trees):
         feat=jnp.asarray(feat), threshold=jnp.asarray(thr),
         default_left=jnp.asarray(dleft), missing=jnp.asarray(miss),
         path=jnp.asarray(path), leaf_depth=jnp.asarray(depth),
-        leaf_value=jnp.asarray(lval))
+        leaf_value=jnp.asarray(lval),
+        is_cat=jnp.asarray(is_cat),
+        cat_table=jnp.asarray(cat_table),
+        cat_cols=jnp.asarray([f for f in cat_cols], jnp.int32)
+        if cat_cols else jnp.zeros(0, jnp.int32),
+        cat_off=jnp.asarray([offs[f] for f in cat_cols], jnp.int32)
+        if cat_cols else jnp.zeros(0, jnp.int32),
+        cat_card=jnp.asarray([cards[f] for f in cat_cols], jnp.int32)
+        if cat_cols else jnp.zeros(0, jnp.int32))
 
 
-def _one_tree_match(tree, nan_mask, clean):
+def _cat_expansion(mf: MatmulForest, nan_mask, clean):
+    """[N, V] bf16 one-hot block expansion of the categorical columns
+    (loop-invariant across trees — built once per dispatch). Out-of-range
+    and NaN categories hit no block cell, so their table product is 0."""
+    v = mf.cat_table.shape[1]
+    if v == 0:
+        return None
+    n = clean.shape[0]
+    fc = mf.cat_cols.shape[0]
+    vals = jnp.take(clean, mf.cat_cols, axis=1)           # [N, Fc]
+    nanv = jnp.take(nan_mask, mf.cat_cols, axis=1)
+    iv = jnp.floor(vals).astype(jnp.int32)
+    ok = (~nanv) & (iv >= 0) & (iv < mf.cat_card[None, :])
+    # one scatter, O(N*Fc): invalid cells land in a per-feature parking
+    # column beyond v (distinct per feature, so every (row, pos) index
+    # is unique) and are sliced away
+    pos = jnp.where(ok, iv + mf.cat_off[None, :],
+                    v + jnp.arange(fc, dtype=jnp.int32)[None, :])
+    rows = jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32)[:, None],
+                            pos.shape)
+    expanded = jnp.zeros((n, v + fc), jnp.bfloat16)
+    expanded = expanded.at[rows, pos].set(1.0, unique_indices=True)
+    return expanded[:, :v]
+
+
+def _one_tree_match(tree, nan_mask, clean, expanded=None):
     """[N, L] exact one-hot leaf membership of one tree (tree = per-tree
-    slice of a MatmulForest)."""
-    feat, thr, dleft, miss, path, depth, _ = tree
+    slice of a MatmulForest; expanded = the shared [N, V] categorical
+    block expansion, None for category-free forests)."""
+    feat, thr, dleft, miss, path, depth = (
+        tree.feat, tree.threshold, tree.default_left, tree.missing,
+        tree.path, tree.leaf_depth)
     f = clean.shape[1]
     onehot = (jnp.arange(f, dtype=jnp.int32)[:, None]
               == feat[None, :]).astype(jnp.float32)           # [F, M]
@@ -350,11 +450,19 @@ def _one_tree_match(tree, nan_mask, clean):
                         preferred_element_type=jnp.float32) > 0.5
     is_zero = jnp.abs(fsel) <= K_ZERO_THRESHOLD
     is_missing = (((miss[None, :] == MISSING_NAN) & is_nan)
-                  | ((miss[None, :] == MISSING_ZERO)
+                  | (((miss[None, :]) == MISSING_ZERO)
                      & (is_zero | is_nan)))
     go_left = jnp.where(is_missing, dleft[None, :],
                         fsel <= thr[None, :])
     D = jnp.where(go_left, 1.0, -1.0).astype(jnp.bfloat16)    # [N, M]
+    if expanded is not None:
+        # exactly one +-1 cell per (row, cat node); 0 = NaN/out-of-range
+        # category -> right (the _decide_raw contract)
+        dcat = jnp.einsum("nv,vm->nm", expanded,
+                          tree.cat_table.astype(jnp.bfloat16),
+                          preferred_element_type=jnp.float32)
+        dcat = jnp.where(dcat > 0.5, 1.0, -1.0).astype(jnp.bfloat16)
+        D = jnp.where(tree.is_cat[None, :], dcat, D)
     # +-1 x {-1,0,+1} products and integer partial sums <= 254 are exact
     # in bf16 inputs + f32 accumulation
     S = jnp.einsum("nm,ml->nl", D, path.astype(jnp.bfloat16),
@@ -362,10 +470,15 @@ def _one_tree_match(tree, nan_mask, clean):
     return S == depth[None, :]
 
 
+_FOREST_LEVEL_FIELDS = ("cat_cols", "cat_off", "cat_card")
+
+
 def _tree_batches(mf: MatmulForest, batch: int):
-    """Reshape [T, ...] -> [ceil(T/b), b, ...] (padding with zero trees:
-    path == 0 everywhere makes S == 0 != leaf_depth(-1) so padding trees
-    match no leaf and contribute nothing)."""
+    """Reshape the per-tree fields [T, ...] -> [ceil(T/b), b, ...]
+    (padding with zero trees: path == 0 everywhere makes S == 0 !=
+    leaf_depth(-1) so padding trees match no leaf and contribute
+    nothing). Forest-level fields (the categorical expansion spec) are
+    nulled out — they are consumed outside the tree scan."""
     t = mf.feat.shape[0]
     nb = (t + batch - 1) // batch
     pad = nb * batch - t
@@ -375,8 +488,9 @@ def _tree_batches(mf: MatmulForest, batch: int):
             a = jnp.pad(a, ((0, pad),) + ((0, 0),) * (a.ndim - 1))
         return a.reshape((nb, batch) + a.shape[1:])
 
+    per_tree = mf._replace(**{f: None for f in _FOREST_LEVEL_FIELDS})
+    padded = jax.tree.map(prep, per_tree)
     # padding leaf_depth must stay -1 (unmatchable), not 0
-    padded = jax.tree.map(prep, mf)
     if pad:
         depth = padded.leaf_depth.at[-1, -pad:, :].set(-1.0)
         padded = padded._replace(leaf_depth=depth)
@@ -391,11 +505,12 @@ def predict_forest_raw_matmul(mf: MatmulForest, data: jnp.ndarray,
     1-tree scan spent ~18 ms/tree on step overhead alone."""
     nan_mask = jnp.isnan(data)
     clean = jnp.where(nan_mask, 0.0, data)
+    expanded = _cat_expansion(mf, nan_mask, clean)
     batched = _tree_batches(mf, tree_batch)
 
     def body(acc, trees):
         def one(tree):
-            match = _one_tree_match(tree, nan_mask, clean)
+            match = _one_tree_match(tree, nan_mask, clean, expanded)
             # HIGHEST: one-hot x f32 leaf values stay exact (default
             # bf16 inputs would truncate the leaf values)
             return jnp.einsum("nl,l->n", match.astype(jnp.float32),
@@ -418,13 +533,17 @@ def predict_forest_leaf_matmul(mf: MatmulForest, data: jnp.ndarray,
     t = mf.feat.shape[0]
     l = mf.leaf_value.shape[1]
     idx = jnp.arange(l, dtype=jnp.float32)
+    expanded = _cat_expansion(mf, nan_mask, clean)
     batched = _tree_batches(mf, tree_batch)
 
     def body(_, trees):
         def one(tree):
-            match = _one_tree_match(tree, nan_mask, clean)
+            match = _one_tree_match(tree, nan_mask, clean, expanded)
+            # HIGHEST: default TPU precision truncates operands to bf16,
+            # which rounds leaf indices > 256 (num_leaves can be 4095)
             return jnp.einsum("nl,l->n", match.astype(jnp.float32),
-                              idx, preferred_element_type=jnp.float32)
+                              idx, preferred_element_type=jnp.float32,
+                              precision=jax.lax.Precision.HIGHEST)
 
         return None, jax.vmap(one)(trees)
 

@@ -147,3 +147,72 @@ def test_cli_binary_fast_path_and_two_round(data_files):
                  f"output_model={m3}", "verbose=-1"]) == 0
     t3 = [ln for ln in open(m3) if not ln.startswith("init_score")]
     assert t1 == t3
+
+
+def test_cli_multiclass_example_conf(tmp_path):
+    """examples/multiclass_classification runs end-to-end through the
+    CLI in the reference conf format (reference:
+    examples/multiclass_classification/train.conf)."""
+    import shutil
+    from lightgbm_tpu.cli import main
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    exdir = os.path.join(repo, "examples")
+    sys.path.insert(0, exdir)
+    try:
+        import gen_data
+    finally:
+        sys.path.remove(exdir)
+    # generate the data into a temp copy of the example dir
+    workdir = tmp_path / "multiclass_classification"
+    workdir.mkdir()
+    for f in ("train.conf", "predict.conf"):
+        shutil.copy(os.path.join(exdir, "multiclass_classification", f),
+                    workdir / f)
+    old_here, gen_data.HERE = gen_data.HERE, str(tmp_path)
+    try:
+        gen_data.multiclass(n=1400)
+    finally:
+        gen_data.HERE = old_here
+    cwd = os.getcwd()
+    os.chdir(workdir)
+    try:
+        assert main(["config=train.conf", "num_trees=5", "num_leaves=7",
+                     "min_data_in_leaf=5", "verbose=-1"]) == 0
+        assert main(["config=predict.conf", "verbose=-1"]) == 0
+        preds = np.loadtxt("LightGBM_predict_result.txt")
+    finally:
+        os.chdir(cwd)
+    labels = np.loadtxt(workdir / "multiclass.test", delimiter="\t")[:, 0]
+    assert preds.shape == (len(labels), 5)          # per-class probs
+    np.testing.assert_allclose(preds.sum(axis=1), 1.0, atol=1e-5)
+    assert np.mean(preds.argmax(axis=1) == labels) > 0.5
+
+
+def test_python_guide_simple_example(tmp_path):
+    """examples/python-guide/simple_example.py runs as shipped against a
+    generated regression dataset (reference:
+    examples/python-guide/simple_example.py)."""
+    import shutil
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    exdir = os.path.join(repo, "examples")
+    sys.path.insert(0, exdir)
+    try:
+        import gen_data
+    finally:
+        sys.path.remove(exdir)
+    guide = tmp_path / "python-guide"
+    guide.mkdir()
+    shutil.copy(os.path.join(exdir, "python-guide", "simple_example.py"),
+                guide / "simple_example.py")
+    old_here, gen_data.HERE = gen_data.HERE, str(tmp_path)
+    os.makedirs(tmp_path / "regression", exist_ok=True)
+    try:
+        gen_data.regression(n=1500)
+    finally:
+        gen_data.HERE = old_here
+    env = dict(os.environ, PYTHONPATH=repo)
+    out = subprocess.run([sys.executable, str(guide / "simple_example.py")],
+                         capture_output=True, text=True, env=env,
+                         timeout=600)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "RMSE of prediction is" in out.stdout

@@ -328,3 +328,66 @@ def test_pred_early_stop_multiclass():
                           pred_early_stop_freq=1, pred_early_stop_margin=0.0)
     one = gbm.predict(X, raw_score=True, num_iteration=1)
     np.testing.assert_allclose(stopped, one, rtol=1e-6)
+
+
+def test_pipeline_stop_rolls_back_bagged_speculative_tree():
+    """The async pipeline dispatches iteration N+1 before learning that
+    iteration N could not split. Under bagging, N+1 may HAVE split (a
+    fresh bag can open splits) and its leaf values are already in the
+    device score — the stop path must subtract them (round-5 review
+    finding). Scores after stop must equal the sum of the kept models'
+    contributions."""
+    import numpy as np
+
+    import lightgbm_tpu as lgb
+
+    rng = np.random.RandomState(9)
+    n = 3000
+    X = rng.randn(n, 6).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+    params = {"objective": "binary", "verbose": -1, "num_leaves": 15,
+              # large min_data + small bags make no-split stops likely
+              "min_data_in_leaf": 700, "bagging_fraction": 0.55,
+              "bagging_freq": 1, "min_sum_hessian_in_leaf": 1e-3}
+    ds = lgb.Dataset(X, y, params=dict(params))
+    ds.construct()
+    bst = lgb.train(dict(params), ds, num_boost_round=60,
+                    verbose_eval=False)
+    inner = bst._inner
+    # the device score must equal bias + kept trees' train contributions
+    import jax.numpy as jnp
+    from lightgbm_tpu.ops.predict import predict_value_binned
+    acc = jnp.zeros(inner._n_pad, jnp.float32) + inner.init_score_bias
+    for t in inner.models:
+        if t.num_leaves > 1:
+            acc = acc + predict_value_binned(t.to_device(), inner._binned)
+    np.testing.assert_allclose(np.asarray(inner._score[0])[:inner._n],
+                               np.asarray(acc)[:inner._n], atol=1e-4)
+
+
+def test_pipeline_stop_survives_midloop_finalize():
+    """finalize_training() mid-loop (a training-metric eval drains the
+    pipeline) must not swallow the no-split stop: the next update() call
+    still reports termination (round-5 review finding)."""
+    import numpy as np
+
+    import lightgbm_tpu as lgb
+
+    rng = np.random.RandomState(4)
+    n = 1000
+    X = rng.randn(n, 4).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    params = {"objective": "binary", "verbose": -1, "num_leaves": 7,
+              "min_data_in_leaf": 600}   # no split possible after root
+    ds = lgb.Dataset(X, y, params=dict(params))
+    ds.construct()
+    booster = lgb.Booster(params=dict(params), train_set=ds)
+    stops = []
+    for _ in range(6):
+        booster._inner.finalize_training()   # simulate mid-loop drains
+        stops.append(booster.update())
+        if stops[-1]:
+            break
+    assert True in stops, "stop signal was swallowed"
+    assert len(booster._inner.models) == 0 or all(
+        t.num_leaves > 1 for t in booster._inner.models)

@@ -52,3 +52,119 @@ def test_batched_grower_identical_trees(batch_k):
                                   np.asarray(out.leaf_value))
     # and it must actually batch: far fewer data passes than splits
     assert int(out.num_passes) < int(ref.num_passes) // 2
+
+def _grow_cfg(ds, g, h, weight=None, num_leaves=63, **kw):
+    from lightgbm_tpu.learner.grow import FMETA_KEYS
+    fm = {k: jnp.asarray(v) for k, v in ds.feature_meta_arrays().items()}
+    cfg = GrowerConfig(
+        num_leaves=num_leaves, max_bins=int(ds.max_num_bin()), chunk=512,
+        lambda_l1=0.0, lambda_l2=1.0, min_gain_to_split=0.0,
+        min_data_in_leaf=20, min_sum_hessian_in_leaf=1e-3, max_depth=-1,
+        **kw)
+    w = jnp.ones_like(g) if weight is None else weight
+    return grow_tree(
+        jnp.asarray(ds.binned), g, h, w,
+        jnp.ones(ds.num_features, bool), *[fm[k] for k in FMETA_KEYS], cfg)
+
+
+def _int_friendly_case(n=4096, f=10, seed=7, bag=False):
+    """Gradients on a coarse binary grid: every per-row product is
+    bf16-exact (hi/lo residual 0) and every partial sum is an exact f32
+    integer multiple, so histogram sums are identical for ANY summation
+    order — subtraction and compaction must then give bit-identical
+    trees, not merely close ones."""
+    rng = np.random.RandomState(seed)
+    X = np.asarray(rng.randn(n, f), np.float32)
+    X[rng.rand(n, f) < 0.05] = np.nan
+    y = (np.nan_to_num(X[:, 0]) + np.nan_to_num(X[:, 1]) ** 2
+         + 0.3 * rng.randn(n)).astype(np.float32)
+    ds = lgb.basic.Dataset(X, y)._lazy_init()
+    g = jnp.asarray(np.clip(np.round(-y * 4) / 4, -8, 8))
+    h = jnp.ones_like(g)
+    w = jnp.asarray((rng.rand(n) < 0.8).astype(np.float32)) if bag else None
+    return ds, g, h, w
+
+
+def _assert_same_tree(a, b):
+    assert int(a.num_leaves_used) == int(b.num_leaves_used)
+    for field in ("node_feature", "node_threshold", "node_default_left",
+                  "leaf_id", "leaf_value"):
+        np.testing.assert_array_equal(np.asarray(getattr(a, field)),
+                                      np.asarray(getattr(b, field)))
+
+
+def test_sibling_subtraction_identical_trees():
+    """hist_subtract builds only the smaller child per expansion and
+    derives the larger as parent - smaller (the reference's
+    FeatureHistogram::Subtract, feature_histogram.hpp:64-70); on
+    order-invariant sums the grown tree must be bit-identical."""
+    ds, g, h, _ = _int_friendly_case()
+    base = _grow_cfg(ds, g, h, batch_k=8)
+    sub = _grow_cfg(ds, g, h, batch_k=8, hist_subtract=True)
+    _assert_same_tree(base, sub)
+    assert int(base.num_leaves_used) > 10
+
+
+def test_speculation_throttle_keeps_passes_bounded():
+    """Late-boosting gain landscapes are flat/noisy; without the
+    budget-aware speculation throttle (grow.py expand()) the node table
+    fills with never-committed expansions and passes degrade to ~one
+    commit each (measured 18 -> 145 passes/tree by iteration 100 at 2M
+    rows). Noisy labels reproduce the flat-gain regime: the tree must
+    still grow in far fewer passes than commits, bit-identically to the
+    sequential grower."""
+    rng = np.random.RandomState(11)
+    n, f = 8192, 10
+    X = np.asarray(rng.randn(n, f), np.float32)
+    y = rng.randn(n).astype(np.float32)          # pure noise gains
+    ds = lgb.basic.Dataset(X, y)._lazy_init()
+    g = jnp.asarray(np.round(-y * 4) / 4)
+    h = jnp.ones_like(g)
+    out = _grow_cfg(ds, g, h, batch_k=8, num_leaves=255,
+                    hist_subtract=True)
+    ref = _grow_cfg(ds, g, h, batch_k=1, num_leaves=255)
+    _assert_same_tree(ref, out)
+    commits = int(out.num_leaves_used) - 1
+    assert commits > 100
+    assert int(out.num_passes) < commits // 2
+    # and the table must not have been exhausted by mis-speculation
+    m_cap = 6 * 255 + 2 * 8 + 2
+    assert int(out.next_free) < m_cap - 2 * (255 - int(out.num_leaves_used))
+
+
+def test_subtraction_with_bagging_weights():
+    """Out-of-bag (weight 0) rows still route (their leaf ids feed the
+    final score update); bagged runs must stay bit-identical with
+    subtraction on."""
+    ds, g, h, w = _int_friendly_case(bag=True)
+    base = _grow_cfg(ds, g, h, weight=w, batch_k=8)
+    both = _grow_cfg(ds, g, h, weight=w, batch_k=8, hist_subtract=True)
+    _assert_same_tree(base, both)
+
+
+def test_subtraction_respects_padding_suffix():
+    """Padding rows (beyond n_valid) contribute nothing; real-row trees
+    must be unchanged under subtraction + padding."""
+    from lightgbm_tpu.learner.grow import FMETA_KEYS
+    ds, g, h, _ = _int_friendly_case(n=3072)
+    n, pad = 3072, 1024
+    fm = {k: jnp.asarray(v) for k, v in ds.feature_meta_arrays().items()}
+    binned_p = np.pad(np.asarray(ds.binned), ((0, pad), (0, 0)))
+    gp = jnp.asarray(np.pad(np.asarray(g), (0, pad)))
+    hp = jnp.asarray(np.pad(np.asarray(h), (0, pad)))
+    wp = jnp.asarray(np.pad(np.ones(n, np.float32), (0, pad)))
+    cfg = GrowerConfig(
+        num_leaves=63, max_bins=int(ds.max_num_bin()), chunk=512,
+        lambda_l1=0.0, lambda_l2=1.0, min_gain_to_split=0.0,
+        min_data_in_leaf=20, min_sum_hessian_in_leaf=1e-3, max_depth=-1,
+        batch_k=8, hist_subtract=True)
+    out = grow_tree(jnp.asarray(binned_p), gp, hp, wp,
+                    jnp.ones(ds.num_features, bool),
+                    *[fm[k] for k in FMETA_KEYS], cfg,
+                    n_valid=jnp.int32(n))
+    base = _grow_cfg(ds, g, h, batch_k=8)
+    assert int(out.num_leaves_used) == int(base.num_leaves_used)
+    np.testing.assert_array_equal(np.asarray(out.node_feature),
+                                  np.asarray(base.node_feature))
+    np.testing.assert_array_equal(np.asarray(out.leaf_id)[:n],
+                                  np.asarray(base.leaf_id))
