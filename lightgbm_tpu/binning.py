@@ -38,12 +38,12 @@ K_ZERO_RANGE = 1e-35
 K_SPARSE_THRESHOLD_DEFAULT = 0.8
 
 
-def _greedy_find_bin(distinct_values: np.ndarray, counts: np.ndarray,
-                     max_bin: int, total_cnt: int, min_data_in_bin: int) -> List[float]:
-    """Equal-count greedy binning (reference: GreedyFindBin, bin.cpp:70-140).
-
-    Returns bin upper bounds; last bound is +inf.
-    """
+def _greedy_find_bin_seq(distinct_values: np.ndarray, counts: np.ndarray,
+                         max_bin: int, total_cnt: int,
+                         min_data_in_bin: int) -> List[float]:
+    """Value-by-value form of the equal-count greedy binning — the
+    direct transcription of the algorithm, kept as the equality oracle
+    for the bin-by-bin fast path below (tests/test_binning.py)."""
     num_distinct = len(distinct_values)
     bin_upper_bound: List[float] = []
     if max_bin <= 0:
@@ -87,6 +87,103 @@ def _greedy_find_bin(distinct_values: np.ndarray, counts: np.ndarray,
             if not is_big[i]:
                 rest_bin_cnt -= 1
                 mean_bin_size = rest_sample_cnt / max(rest_bin_cnt, 1)
+    bin_cnt += 1
+    out = []
+    for i in range(bin_cnt - 1):
+        out.append((upper_bounds[i] + lower_bounds[i + 1]) / 2.0)
+    out.append(np.inf)
+    return out
+
+
+def _greedy_find_bin(distinct_values: np.ndarray, counts: np.ndarray,
+                     max_bin: int, total_cnt: int, min_data_in_bin: int) -> List[float]:
+    """Equal-count greedy binning (reference: GreedyFindBin, bin.cpp:70-140).
+
+    Returns bin upper bounds; last bound is +inf.
+
+    Fast path: the value loop closes a bin only when a cumulative-count
+    threshold or a dedicated-bin ("big" value) boundary is hit, so the
+    closure indices can be found bin-by-bin with searchsorted/bisect on
+    precomputed prefix sums — O(bins log n) instead of a python loop
+    over up to sample_cnt distinct values (the loop dominated dataset
+    construction at 2M rows: 3.2 s of the 8.3 s total). Each searchsorted
+    landing is verified with exact integer arithmetic so the result is
+    bit-identical to the sequential form (tests/test_binning.py fuzzes
+    the equivalence).
+    """
+    num_distinct = len(distinct_values)
+    if max_bin <= 0:
+        log.fatal("max_bin must be > 0")
+    if num_distinct <= max_bin:
+        # small-distinct branch: the loop is <= max_bin steps already
+        return _greedy_find_bin_seq(distinct_values, counts, max_bin,
+                                    total_cnt, min_data_in_bin)
+
+    if min_data_in_bin > 0:
+        max_bin = max(1, min(max_bin, total_cnt // min_data_in_bin))
+    mean_bin_size = total_cnt / max_bin
+    is_big = counts >= mean_bin_size
+    rest_bin_cnt = max_bin - int(is_big.sum())
+    rest_all = total_cnt - int(counts[is_big].sum())
+    mean_bin_size = rest_all / max(rest_bin_cnt, 1)
+
+    c64 = counts.astype(np.int64)
+    C = np.cumsum(c64)                       # C[i] = counts[0..i]
+    Cnb = np.cumsum(np.where(is_big, 0, c64))  # non-big prefix
+    big_idx = np.flatnonzero(is_big).tolist()  # sorted python list
+    # candidates for the "next value is big" closure rule
+    bigm1 = [b - 1 for b in big_idx]
+
+    def cum(i, s):                           # counts[s..i], exact ints
+        return int(C[i]) - (int(C[s - 1]) if s > 0 else 0)
+
+    upper_bounds = [np.inf] * max_bin
+    lower_bounds = [np.inf] * max_bin
+    bin_cnt = 0
+    lower_bounds[0] = distinct_values[0]
+    s = 0                                    # current segment start
+    last = num_distinct - 2                  # loop bound of the seq form
+    import bisect
+    while s <= last and bin_cnt < max_bin - 1:
+        base = int(C[s - 1]) if s > 0 else 0
+        # rule A: first big value in [s, last]
+        a = bisect.bisect_left(big_idx, s)
+        iA = big_idx[a] if a < len(big_idx) else num_distinct
+        # rule B: first i with counts[s..i] >= mean_bin_size. Clamp to s:
+        # once the remaining non-big mass is exhausted mean_bin_size is
+        # 0 and searchsorted(C, base+0) resolves BEFORE the segment
+        # start (the sequential form closes at s in that state) — an
+        # unclamped iB re-closed the previous bin and emitted duplicate
+        # bounds (round-5 review finding, fuzz-reproduced)
+        iB = int(np.searchsorted(C, base + mean_bin_size, side="left"))
+        while iB - 1 >= s and cum(iB - 1, s) >= mean_bin_size:
+            iB -= 1
+        while iB < num_distinct and cum(min(iB, num_distinct - 1), s) < mean_bin_size:
+            iB += 1
+        iB = max(iB, s)
+        # rule C: first i with is_big[i+1] and counts[s..i] >= half-mean
+        half = max(1.0, mean_bin_size * 0.5)
+        i0 = int(np.searchsorted(C, base + half, side="left"))
+        while i0 - 1 >= s and cum(i0 - 1, s) >= half:
+            i0 -= 1
+        while i0 < num_distinct and cum(min(i0, num_distinct - 1), s) < half:
+            i0 += 1
+        i0 = max(i0, s)
+        cpos = bisect.bisect_left(bigm1, max(s, i0))
+        iC = bigm1[cpos] if cpos < len(bigm1) else num_distinct
+        i = min(iA, iB, iC)
+        if i > last:
+            break
+        upper_bounds[bin_cnt] = distinct_values[i]
+        bin_cnt += 1
+        lower_bounds[bin_cnt] = distinct_values[i + 1]
+        if not is_big[i]:
+            rest_bin_cnt -= 1
+            rest_sample = rest_all - int(Cnb[i])
+            mean_bin_size = rest_sample / max(rest_bin_cnt, 1)
+            # the new mean can reclassify nothing (is_big is fixed), so
+            # only the thresholds move — state is fully captured here
+        s = i + 1
     bin_cnt += 1
     out = []
     for i in range(bin_cnt - 1):
@@ -414,13 +511,19 @@ def find_bin_mappers(data: np.ndarray, max_bin: int, min_data_in_bin: int = 3,
         sample = data
         total = n
     cats = set(categorical_features or [])
-    mappers = []
-    for j in range(f):
+
+    def _one(j):
         col = np.asarray(sample[:, j], dtype=np.float64)
         m = BinMapper()
         nonzero = col[(col != 0.0) | np.isnan(col)]
         m.find_bin(nonzero, total, max_bin, min_data_in_bin, min_split_data,
                    BIN_CATEGORICAL if j in cats else BIN_NUMERICAL,
                    use_missing, zero_as_missing)
-        mappers.append(m)
-    return mappers
+        return m
+
+    # thread pool: np.unique/sort/cumsum in find_bin release the GIL
+    if f > 4 and total > 50_000:
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(max_workers=8) as ex:
+            return list(ex.map(_one, range(f)))
+    return [_one(j) for j in range(f)]

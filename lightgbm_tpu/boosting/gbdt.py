@@ -71,11 +71,6 @@ def _jit_forest_raw_matmul(mf, data):
     return _forest_raw_matmul_jit(mf, data)
 
 
-def _pallas_available() -> bool:
-    from ..ops import hist_pallas
-    return hist_pallas.available()
-
-
 def _pad_to(arr: np.ndarray, n: int, value=0):
     pad = n - arr.shape[0]
     if pad <= 0:
@@ -383,10 +378,10 @@ class GBDT:
                 m.init(train_data.metadata, n)
                 self.metrics.append(m)
 
-        use_pallas = (self.config.tree.tpu_hist_pallas
-                      and self._tree_learner_kind == "serial"
-                      and _pallas_available())
-
+        if self.config.tree.tpu_hist_pallas:
+            log.warning("tpu_hist_pallas is retired: the hand-written "
+                        "kernel measured slower than the XLA path "
+                        "(profiles/README.md); using the XLA kernels")
         # --- execution-schedule auto-selection ----------------------------
         # (bit-identical trees for any batch_k; subtraction/compaction only
         # change f32 summation order). "wide" shapes (large groups*bins)
@@ -411,13 +406,15 @@ class GBDT:
                        // max(L_cfg, 1))
         subtract = (self.config.tree.tpu_hist_subtract
                     and self._tree_learner_kind == "serial"
-                    and not use_pallas
                     # vmap'd class trees each carry a cache: the x k_cls
                     # scatter/memory traffic measured a net LOSS on the
                     # multiclass shape (0.62 vs 0.89 Mrow-iters/s)
                     and k_cls == 1
                     and mult_fit >= 6)
-        table_mult = min(12, mult_fit) if subtract else 12
+        # vmap'd class trees multiply every [M]-sized table op by k_cls:
+        # the measured multiclass optimum is a smaller table
+        table_mult = min(12, mult_fit) if subtract else \
+            (6 if k_cls > 1 else 12)
         import os as _os
         if _os.environ.get("LGBM_TPU_TABLE_MULT"):      # debug override
             table_mult = int(_os.environ["LGBM_TPU_TABLE_MULT"])
@@ -461,7 +458,6 @@ class GBDT:
                                  if train_data.groups is not None
                                  and train_data.groups.num_groups
                                  else train_data.num_bins_per_feature())),
-            use_pallas=use_pallas,
         )
 
         # build the distributed grower + finalize the (possibly feature-
@@ -475,6 +471,16 @@ class GBDT:
                 self._dist_grower = FeatureParallelGrower(
                     mesh, self._grower_cfg, axis="feature")
                 binned_host, fm = self._dist_grower.pad_features(binned_host, fm)
+                # rebuild the static width plan over the PADDED feature
+                # axis so the narrow-block bin-width discount survives
+                # feature sharding (grow.py shard_group_widths)
+                self._grower_cfg = self._grower_cfg._replace(
+                    group_widths=tuple(int(b) for b in fm["num_bin"]))
+                # the grower reads the DIST cfg (captured at construction,
+                # before padding) — keep it in sync or the width plan
+                # silently drops (round-5 review finding)
+                self._dist_grower.cfg = self._dist_grower.cfg._replace(
+                    group_widths=self._grower_cfg.group_widths)
             elif self._tree_learner_kind == "voting":
                 mesh = make_mesh(axis_name="data")
                 self._dist_grower = VotingParallelGrower(
@@ -800,17 +806,38 @@ class GBDT:
             small, shrink = self._pending_small
             self._pending_small = None
             self.iter_ -= 1
-            import jax
-            host_state = _HostState(jax.device_get(small))
-            tree = Tree.from_grower_state(host_state, self.train_data)
+            tree = self._materialize_small(small, shrink, fold_bias=False)
             if tree.num_leaves > 1:
-                tree.apply_shrinkage(shrink)
                 neg = copy.deepcopy(tree)
                 neg.leaf_value = -neg.leaf_value
                 self._score = self._score.at[0].add(
                     predict_value_binned(neg.to_device(), self._binned))
             return True
         return False
+
+    def _materialize_small(self, small, shrink, fold_bias=True):
+        """Device small-state -> host Tree (+ shrinkage and, for kept
+        trees, the one-time boost-from-average bias fold) — the single
+        copy both the pipelined flush and its rollback path use."""
+        import jax
+
+        from .. import tracing
+        with tracing.phase("tree/extract"):
+            host_state = _HostState(jax.device_get(small))
+            tree = Tree.from_grower_state(host_state, self.train_data)
+        if tree.num_leaves > 1:
+            tree.apply_shrinkage(shrink)
+            if fold_bias and \
+                    abs(getattr(self, "_pending_bias", 0.0)) > _K_EPSILON:
+                tree.add_bias(self._pending_bias)
+                self._pending_bias = 0.0
+                self.init_score_bias = 0.0
+        # schedule observability (scripts/profile_train.py + PARITY.md)
+        if not hasattr(self, "pass_log"):
+            self.pass_log = []
+        self.pass_log.append((int(host_state.num_passes),
+                              int(host_state.next_free)))
+        return tree
 
     def _flush_pending(self) -> bool:
         """Materialize the pipelined tree, if any. Returns False when the
@@ -819,23 +846,8 @@ class GBDT:
             return True
         small, shrink = self._pending_small
         self._pending_small = None
-        import jax
-
-        from .. import tracing
-        with tracing.phase("tree/extract"):
-            host_state = _HostState(jax.device_get(small))
-            tree = Tree.from_grower_state(host_state, self.train_data)
-        # schedule observability (scripts/profile_train.py + PARITY.md)
-        if not hasattr(self, "pass_log"):
-            self.pass_log = []
-        self.pass_log.append((int(host_state.num_passes),
-                              int(host_state.next_free)))
+        tree = self._materialize_small(small, shrink)
         if tree.num_leaves > 1:
-            tree.apply_shrinkage(shrink)
-            if abs(getattr(self, "_pending_bias", 0.0)) > _K_EPSILON:
-                tree.add_bias(self._pending_bias)
-                self._pending_bias = 0.0
-                self.init_score_bias = 0.0
             self.models.append(tree)
             return True
         self.iter_ -= 1
@@ -973,7 +985,8 @@ class GBDT:
                             num_iteration: int = -1,
                             pred_early_stop: bool = False,
                             pred_early_stop_freq: int = 10,
-                            pred_early_stop_margin: float = 10.0) -> np.ndarray:
+                            pred_early_stop_margin: float = 10.0,
+                            transform=None) -> np.ndarray:
         """Raw scores [num_data, num_tree_per_iteration] from raw features.
 
         Trees are stacked to device ONCE; only the row axis is chunked
@@ -1031,15 +1044,23 @@ class GBDT:
                     int(pred_early_stop_freq)), np.float64)
             elif total > 0:
                 for cls, (mf, st) in enumerate(class_stacks):
-                    if mf is not None:
-                        out[cls, sl] = np.asarray(
-                            _jit_forest_raw_matmul(mf, dj), np.float64)
-                    elif st is not None:
-                        out[cls, sl] = np.asarray(
-                            _jit_forest_raw(st, dj), np.float64)
-        if self.average_output and total > 0:
-            out /= max(total // k, 1)
-        out += self.init_score_bias
+                    raw = _jit_forest_raw_matmul(mf, dj) if mf is not None \
+                        else (_jit_forest_raw(st, dj) if st is not None
+                              else None)
+                    if raw is None:
+                        continue
+                    if transform is not None:
+                        # output transform fused on device: ONE f32 fetch
+                        # instead of fetch-raw + re-upload + fetch-converted
+                        # (each blocking relay fetch of a 500k-row f64
+                        # vector measured ~1.3 s — more than the forest
+                        # compute itself)
+                        raw = transform(raw)
+                    out[cls, sl] = np.asarray(raw, np.float64)
+        if transform is None:
+            if self.average_output and total > 0:
+                out /= max(total // k, 1)
+            out += self.init_score_bias
         return out.T
 
     def predict(self, data: np.ndarray, num_iteration: int = -1,
@@ -1071,6 +1092,32 @@ class GBDT:
         if pred_contrib:
             from ..shap import predict_contrib
             return predict_contrib(self, np.asarray(data, np.float64), num_iteration)
+        k = self.num_tree_per_iteration
+        total_cap = len(self.models)
+        if num_iteration > 0:
+            total_cap = min(total_cap, num_iteration * k)
+        if (not raw_score and self.objective is not None and k == 1
+                and not pred_early_stop and total_cap > 0):
+            # single-class fast path: bias/averaging + the objective's
+            # output transform run on device before the single fetch.
+            # Zero-tree models fall through to the slow path, which
+            # returns the transformed bias prior; the averaging
+            # denominator honors the num_iteration cap.
+            obj = self.objective
+            denom = float(max(total_cap // k, 1)) \
+                if self.average_output else 1.0
+            bias = float(self.init_score_bias)
+            if getattr(self, "_fused_convert", None) is None:
+                import jax
+
+                def _conv(r, d, b):
+                    return obj.convert_output(r / d + b)
+
+                self._fused_convert = jax.jit(_conv)
+            tr = lambda r: self._fused_convert(
+                r, jnp.float32(denom), jnp.float32(bias))
+            raw = self._predict_raw_matrix(data, num_iteration, transform=tr)
+            return raw[:, 0]
         raw = self._predict_raw_matrix(
             data, num_iteration, pred_early_stop=pred_early_stop,
             pred_early_stop_freq=pred_early_stop_freq,
@@ -1079,7 +1126,6 @@ class GBDT:
             return raw[:, 0] if raw.shape[1] == 1 else raw
         conv = np.asarray(self.objective.convert_output(
             jnp.asarray(raw.T.reshape(-1), jnp.float32)), np.float64)
-        k = self.num_tree_per_iteration
         if k == 1:
             return conv
         return conv.reshape(k, -1).T

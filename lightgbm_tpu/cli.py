@@ -229,11 +229,13 @@ def run_predict(params: Dict, cfg: Config) -> None:
         pred_early_stop_margin=cfg.io.pred_early_stop_margin)
     result = np.atleast_1d(np.asarray(result))
     with open(cfg.io.output_result, "w") as fh:
-        for row in result:
-            if np.ndim(row) == 0:
-                fh.write(f"{float(row):.9g}\n")
-            else:
-                fh.write("\t".join(f"{float(x):.9g}" for x in row) + "\n")
+        # vectorized formatting (np.char.mod runs the %-format in C): a
+        # per-row python f-string loop cost ~1s at 500k rows
+        if result.ndim <= 1:
+            fh.write("\n".join(np.char.mod("%.9g", result)) + "\n")
+        else:
+            rows = np.char.mod("%.9g", result)
+            fh.write("\n".join("\t".join(r) for r in rows) + "\n")
     log.info("Finished prediction, results saved to %s", cfg.io.output_result)
 
 

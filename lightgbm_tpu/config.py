@@ -182,11 +182,10 @@ class TreeConfig:
     # child's histogram per expansion. Auto-disabled when the cache
     # would exceed its device-memory budget (boosting/gbdt.py).
     tpu_hist_subtract: bool = True
-    # opt-in fused pallas histogram kernel (ops/hist_pallas.py). Off by
-    # default: measured on v5e, XLA's own fusion of the one-hot compare
-    # into the dot already matches it (11.1 vs 14.4 ms/pass at 2M x 28
-    # x 64 x 24-leaves), so the portable path wins until the kernel
-    # exploits sub-32-bit compares (blocked on Mosaic layout support).
+    # RETIRED (accepted for compat, warns): the hand-written pallas
+    # histogram kernel measured slower than XLA's own fusion of the
+    # one-hot compare into the dot (14.4 vs 11.1 ms/pass at 2M x 28 x 64)
+    # and was removed; see profiles/README.md for the postmortem
     tpu_hist_pallas: bool = False
 
 

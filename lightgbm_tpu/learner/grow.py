@@ -134,9 +134,6 @@ class GrowerConfig(NamedTuple):
     # Ignored under feature parallelism (each shard sees a traced feature
     # offset, so a static per-shard plan is impossible there).
     group_widths: tuple = ()
-    # fused pallas histogram kernel (ops/hist_pallas.py) — TPU serial
-    # learner only; the GBDT layer sets this from backend + config
-    use_pallas: bool = False
     # sibling subtraction (reference: FeatureHistogram::Subtract,
     # feature_histogram.hpp:64-70, retained by the HistogramPool,
     # feature_histogram.hpp:380-548): keep every speculative node's group
@@ -534,30 +531,33 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     # the round loop)
     binned_T = binned.T
 
-    gw = cfg.group_widths \
-        if (cfg.feature_axis is None
-            and len(cfg.group_widths) == local_binned.shape[1]) else None
-    # fused pallas kernels: serial bf16 path only (the distributed
-    # learners keep the portable XLA kernels under shard_map)
-    pallas_on = (cfg.use_pallas and cfg.hist_bf16
-                 and cfg.data_axis is None and cfg.feature_axis is None)
+    if (cfg.feature_axis is None
+            and len(cfg.group_widths) == local_binned.shape[1]):
+        gw = cfg.group_widths
+    elif (cfg.feature_axis is not None
+          and len(cfg.group_widths) == g_cols
+          and g_cols % cfg.num_feature_shards == 0):
+        # feature parallelism: each shard's feature block starts at a
+        # TRACED offset, so a per-shard exact plan is impossible — but a
+        # single static plan at the PER-POSITION MAX width across shards
+        # is valid for every shard (a one-hot wider than the shard's
+        # actual bin count just never matches the extra lanes). On
+        # homogeneous-width data (the Epsilon 15-bin regime this
+        # discount exists for) the max equals the true width and the
+        # full narrow-block discount survives sharding.
+        gw = shard_group_widths(cfg.group_widths, cfg.num_feature_shards)
+    else:
+        gw = None
     # sibling subtraction: voting keeps LOCAL histograms (the cache would
     # have to be local too and the elected-slice exchange breaks the
-    # parent-minus-child identity), and the pallas kernels have their own
-    # channel packing — both keep the direct 2K-children path
-    subtract = cfg.hist_subtract and not voting and not pallas_on
+    # parent-minus-child identity) so it keeps the direct 2K-children path
+    subtract = cfg.hist_subtract and not voting
 
     # --- root (BeforeTrain: serial_tree_learner.cpp:234-323) ------------
-    if pallas_on:
-        from ..ops import hist_pallas
-        root_hist = hist_pallas.leaf_histogram_tpu(
-            binned_T, w3, B, cfg.chunk, n_valid=nv_local,
-            group_widths=gw)
-    else:
-        root_hist = reduce_hist(
-            hist_ops.leaf_histogram(local_binned, w3, B, cfg.chunk,
-                                    bf16=cfg.hist_bf16, n_valid=nv_local,
-                                    group_widths=gw))
+    root_hist = reduce_hist(
+        hist_ops.leaf_histogram(local_binned, w3, B, cfg.chunk,
+                                bf16=cfg.hist_bf16, n_valid=nv_local,
+                                group_widths=gw))
     # global leaf sums: the reference Allreduces (cnt, sum_g, sum_h)
     # (data_parallel_tree_learner.cpp:117-145); summing any feature's bins
     # of the already-reduced histogram gives the same totals
@@ -760,16 +760,10 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
 
         leaf_id = route(carry.leaf_id, lambda grp: jax.lax.dynamic_slice(
             binned_T, (grp, 0), (1, n))[0])
-        if pallas_on:
-            from ..ops import hist_pallas
-            hists = hist_pallas.batched_leaves_histogram_tpu(
-                binned_T, w3, leaf_id, hist_ids, B, cfg.chunk,
-                n_valid=nv_local, group_widths=gw)
-        else:
-            hists = reduce_hist(hist_ops.batched_leaves_histogram(
-                local_binned, w3, leaf_id, hist_ids, B, cfg.chunk,
-                bf16=cfg.hist_bf16, n_valid=nv_local,
-                group_widths=gw))
+        hists = reduce_hist(hist_ops.batched_leaves_histogram(
+            local_binned, w3, leaf_id, hist_ids, B, cfg.chunk,
+            bf16=cfg.hist_bf16, n_valid=nv_local,
+            group_widths=gw))
 
         if subtract:
             # larger child = parent - smaller (the cache holds every
@@ -980,6 +974,16 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         node_count=carry.node_count,
         num_leaves_used=carry.num_leaves_used,
     )
+
+
+def shard_group_widths(group_widths, num_shards: int):
+    """Per-position max of the per-shard feature-block widths: the one
+    static block plan that is correct for every feature shard (see the
+    feature_axis branch in grow_tree)."""
+    fl = len(group_widths) // num_shards
+    return tuple(max(int(group_widths[s * fl + j])
+                     for s in range(num_shards))
+                 for j in range(fl))
 
 
 FMETA_KEYS = ("num_bin", "missing_type", "default_bin", "is_categorical",

@@ -14,6 +14,130 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 
+
+# one-line description per parameter (reference: docs/Parameters.md —
+# rewritten, not copied; TPU-specific flags documented from our code)
+DESCRIPTIONS = {
+    # core
+    "task": "what to do: train, predict, or convert_model",
+    "seed": "master seed fanned out to data/feature/bagging/drop seeds",
+    "boosting_type": "gbdt, dart, goss, or rf",
+    "objective": "loss to optimize: regression, regression_l1, huber, "
+                 "fair, poisson, binary, multiclass, multiclassova, "
+                 "lambdarank, xentropy, xentlambda, none",
+    "tree_learner": "serial, or distributed: feature, data, voting "
+                    "(mapped onto a jax device mesh)",
+    # io
+    "max_bin": "max number of histogram bins per feature",
+    "min_data_in_bin": "minimum rows per value bin during bin finding",
+    "bin_construct_sample_cnt": "rows sampled to find bin boundaries",
+    "data_random_seed": "seed for the bin-finding row sample",
+    "output_model": "path the trained model text is written to",
+    "output_result": "path predictions are written to (task=predict)",
+    "convert_model": "output path for task=convert_model (if-else C++)",
+    "input_model": "model text to load (predict / continued training)",
+    "verbosity": "<0 fatal only, 0 warnings, 1 info, >1 debug",
+    "num_iteration_predict": "use only the first N iterations to predict",
+    "is_pre_partition": "multi-machine: data files are pre-partitioned "
+                        "per rank (no row sharding by the loader)",
+    "is_enable_sparse": "kept for API compat (storage is dense+EFB)",
+    "enable_load_from_binary_file": "reuse <data>.bin when present",
+    "use_two_round_loading": "stream the file twice instead of holding "
+                             "raw values in memory",
+    "is_save_binary_file": "write <data>.bin after construction",
+    "enable_bundle": "exclusive feature bundling (EFB)",
+    "max_conflict_rate": "max fraction of conflicting rows per bundle",
+    "has_header": "data files carry a header row",
+    "label_column": "label selector: index or name:colname",
+    "weight_column": "per-row weight column selector",
+    "group_column": "ranking query/group column selector",
+    "ignore_column": "columns dropped before binning",
+    "categorical_column": "columns treated as categorical (indices or "
+                          "name:c1,c2)",
+    "data_filename": "training data path (CLI)",
+    "valid_data_filenames": "validation data paths (CLI)",
+    "snapshot_freq": "save the model every N iterations",
+    "is_predict_raw_score": "predict raw scores instead of transformed",
+    "is_predict_leaf_index": "predict leaf indices per tree",
+    "is_predict_contrib": "predict TreeSHAP feature contributions",
+    "pred_early_stop": "stop accumulating trees once the margin is safe",
+    "pred_early_stop_freq": "check the margin every N iterations",
+    "pred_early_stop_margin": "margin threshold for prediction early stop",
+    "use_missing": "handle NaN/missing specially (false = plain values)",
+    "zero_as_missing": "treat zeros as missing (sparse semantics)",
+    "sparse_threshold": "column sparsity above which EFB treats the "
+                        "column as sparse when bundling",
+    "init_score_file": "initial scores sidecar for the training data",
+    "valid_init_score_file": "initial-score sidecars for valid sets",
+    # tree
+    "min_data_in_leaf": "minimum rows per leaf",
+    "min_sum_hessian_in_leaf": "minimum hessian sum per leaf",
+    "lambda_l1": "L1 regularization on leaf values",
+    "lambda_l2": "L2 regularization on leaf values",
+    "min_gain_to_split": "minimum gain to accept a split",
+    "num_leaves": "max leaves per tree",
+    "feature_fraction": "features sampled per tree",
+    "feature_fraction_seed": "seed for the per-tree feature sample",
+    "max_depth": "max tree depth (<=0 = unlimited)",
+    "top_k": "features each shard submits in voting-parallel elections",
+    "max_cat_threshold": "max categories grouped on one side of a "
+                         "categorical split",
+    "histogram_pool_size": "kept for API compat (the TPU grower keeps "
+                           "its histogram cache on device)",
+    "gpu_platform_id": "kept for API compat (no OpenCL here)",
+    "gpu_device_id": "kept for API compat",
+    "gpu_use_dp": "kept for API compat",
+    "tpu_hist_chunk": "rows per histogram contraction step",
+    "tpu_double_precision": "f64 accumulation paths where supported",
+    "tpu_batch_k": "nodes speculatively expanded per histogram pass "
+                   "(auto-selected by shape when unset)",
+    "tpu_hist_bf16": "bf16 hi+lo MXU histogram contraction",
+    "tpu_hist_subtract": "sibling-subtraction histogram cache (build "
+                         "the smaller child, derive the larger); "
+                         "auto-disabled when the cache exceeds budget",
+    "tpu_hist_pallas": "opt-in fused pallas histogram kernel",
+    # boosting
+    "num_iterations": "boosting rounds",
+    "learning_rate": "shrinkage applied to each tree",
+    "bagging_fraction": "rows sampled per bagging refresh",
+    "bagging_freq": "refresh the bag every N iterations (0 = off)",
+    "bagging_seed": "seed for bagging",
+    "early_stopping_round": "stop when no metric improves for N rounds",
+    "drop_rate": "DART: fraction of trees dropped per iteration",
+    "max_drop": "DART: max trees dropped per iteration",
+    "skip_drop": "DART: probability of skipping the drop",
+    "uniform_drop": "DART: drop trees uniformly instead of by weight",
+    "xgboost_dart_mode": "DART: xgboost-style normalization",
+    "drop_seed": "DART: seed for the drop choice",
+    "top_rate": "GOSS: keep fraction of largest gradients",
+    "other_rate": "GOSS: sample fraction of the rest",
+    # objective
+    "is_unbalance": "binary: reweight classes to balance label mass",
+    "sigmoid": "sigmoid scale for binary/xentropy objectives",
+    "huber_delta": "huber loss delta",
+    "fair_c": "fair loss c",
+    "poisson_max_delta_step": "poisson: max delta step safeguard",
+    "gaussian_eta": "regression hessian eta",
+    "scale_pos_weight": "binary: weight multiplier on positives",
+    "boost_from_average": "start scores from the label average",
+    "label_gain": "lambdarank: gain per integer relevance label",
+    "max_position": "lambdarank: NDCG truncation position",
+    "num_class": "number of classes (multiclass objectives)",
+    # metric
+    "metric_types": "metrics to evaluate (comma list)",
+    "metric_freq": "evaluate every N iterations",
+    "output_freq": "CLI metric print frequency",
+    "is_provide_training_metric": "also evaluate on the training data",
+    "ndcg_eval_at": "NDCG/MAP truncation positions",
+    # network
+    "num_machines": "machine count for distributed training",
+    "local_listen_port": "kept for API compat (jax.distributed wires "
+                         "processes via the coordinator address)",
+    "time_out": "kept for API compat",
+    "machine_list_filename": "host list file (rank order)",
+    "machines": "inline comma-separated host list",
+}
+
 def main():
     from lightgbm_tpu import config as C
 
@@ -43,8 +167,8 @@ def main():
     for title, cls, only in sections:
         out.append(f"## {title}")
         out.append("")
-        out.append("| parameter | default | aliases |")
-        out.append("|---|---|---|")
+        out.append("| parameter | default | aliases | description |")
+        out.append("|---|---|---|---|")
         if dataclasses.is_dataclass(cls):
             fields = dataclasses.fields(cls)
         else:
@@ -62,7 +186,8 @@ def main():
             else:
                 default = ""
             al = ", ".join(sorted(aliases_by_target.get(f.name, [])))
-            out.append(f"| `{f.name}` | `{default}` | {al} |")
+            d = DESCRIPTIONS.get(f.name, "")
+            out.append(f"| `{f.name}` | `{default}` | {al} | {d} |")
         out.append("")
     os.makedirs(os.path.join(REPO, "docs"), exist_ok=True)
     path = os.path.join(REPO, "docs", "Parameters.md")

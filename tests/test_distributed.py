@@ -174,3 +174,51 @@ def test_multiclass_serial_batched_matches_data_parallel():
     assert s_struct == d_struct and len(s_struct) > 0
     np.testing.assert_allclose(m_serial.predict(X), m_dist.predict(X),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_feature_parallel_keeps_narrow_width_plan():
+    """The bin-width discount must survive feature sharding: the grower
+    plans group blocks at the per-position max width across shards
+    (grow.py shard_group_widths), so 15-bin data sharded over features
+    still contracts 16-wide blocks, not max_bins-wide ones — and the
+    feature-parallel trees stay identical to serial."""
+    import numpy as np
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.learner.grow import shard_group_widths
+
+    # unit: per-position max across shards
+    assert shard_group_widths((16, 16, 16, 16, 16, 16, 16, 16), 2) == \
+        (16, 16, 16, 16)
+    assert shard_group_widths((4, 8, 16, 2), 2) == (16, 8)
+
+    rng = np.random.RandomState(3)
+    # f NOT divisible by the 8-device shard count: pad_features extends
+    # the width plan, and the plan the GROWER reads (the dist grower's
+    # cfg, captured at construction) must be the padded one
+    n, f = 4096, 10
+    X = np.round(rng.rand(n, f) * 12).astype(np.float32)  # ~13 bins
+    y = (X[:, 0] + X[:, 1] > 12).astype(np.float32)
+
+    def run(learner):
+        params = {"objective": "binary", "verbose": -1, "max_bin": 15,
+                  "num_leaves": 31, "min_data_in_leaf": 5,
+                  "tree_learner": learner, "enable_bundle": False}
+        ds = lgb.Dataset(X, y, params=dict(params))
+        ds.construct()
+        bst = lgb.train(dict(params), ds, num_boost_round=5,
+                        verbose_eval=False)
+        # the width plan the grower actually consumes must exist, cover
+        # the (padded) feature axis, and stay narrow
+        grower = bst._inner._dist_grower
+        cfg = grower.cfg if grower is not None else bst._inner._grower_cfg
+        widths = cfg.group_widths
+        assert widths and max(widths) <= 16
+        if grower is not None:
+            binned_cols = bst._inner._binned.shape[1]
+            assert len(widths) == binned_cols
+        return bst.predict(X[:400])
+
+    ps = run("serial")
+    pf = run("feature")
+    np.testing.assert_allclose(ps, pf, atol=1e-5)

@@ -255,103 +255,3 @@ def test_batched_leaves_histogram_bf16_single_pass():
         jnp.asarray(ids), B, chunk=128, bf16=True))
     np.testing.assert_allclose(fast, ref, rtol=2e-4, atol=2e-4)
     np.testing.assert_array_equal(fast[:, :, :, 2], ref[:, :, :, 2])
-
-
-def test_pallas_histogram_matches_xla_interpret():
-    """Fused pallas kernel (interpret mode, CPU-runnable) must match the
-    blocked XLA kernel bit-for-bit in bf16 hi/lo mode."""
-    from lightgbm_tpu.ops.histogram import batched_leaves_histogram
-    from lightgbm_tpu.ops.hist_pallas import batched_leaves_histogram_tpu
-    rng = np.random.RandomState(7)
-    n, f, B, C = 512, 5, 16, 4
-    widths = (16, 16, 7, 16, 12)
-    binned = np.stack([rng.randint(0, w, size=n) for w in widths],
-                      axis=1).astype(np.uint8)
-    g = rng.randn(n).astype(np.float32)
-    h = rng.rand(n).astype(np.float32)
-    w = np.stack([g, h, np.ones(n, np.float32)], axis=1)
-    w[-32:] = 0.0  # padding suffix
-    leaf_id = rng.randint(0, 4, size=n).astype(np.int32)
-    ids = np.asarray([0, 2, -1, 3], np.int32)
-    ref = np.asarray(batched_leaves_histogram(
-        jnp.asarray(binned), jnp.asarray(w), jnp.asarray(leaf_id),
-        jnp.asarray(ids), B, chunk=256, bf16=True, group_widths=widths))
-    out = np.asarray(batched_leaves_histogram_tpu(
-        jnp.asarray(binned.T), jnp.asarray(w), jnp.asarray(leaf_id),
-        jnp.asarray(ids), B, chunk=256, group_widths=widths,
-        interpret=True))
-    assert out.shape == ref.shape
-    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
-
-
-def test_pallas_histogram_n_valid_interpret():
-    from lightgbm_tpu.ops.hist_pallas import (batched_leaves_histogram_tpu,
-                                              leaf_histogram_tpu)
-    from lightgbm_tpu.ops.histogram import leaf_histogram
-    rng = np.random.RandomState(9)
-    n, f, B = 1024, 3, 8
-    binned = rng.randint(0, B, size=(n, f)).astype(np.uint8)
-    w = np.stack([rng.randn(n), rng.rand(n), np.ones(n)],
-                 axis=1).astype(np.float32)
-    n_real = 700
-    w[n_real:] = 0.0
-    ref = np.asarray(leaf_histogram(
-        jnp.asarray(binned), jnp.asarray(w), B, chunk=256, bf16=True,
-        n_valid=jnp.int32(n_real)))
-    out = np.asarray(leaf_histogram_tpu(
-        jnp.asarray(binned.T), jnp.asarray(w), B, chunk=256,
-        n_valid=jnp.int32(n_real), interpret=True))
-    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
-
-
-def test_plan_group_blocks_properties():
-    """Block plan must cover all groups contiguously, respect the
-    working-set budget, and give narrow groups a narrow scan width
-    (the dense_nbits 4-bit analogue, src/io/dense_nbits_bin.hpp)."""
-    from lightgbm_tpu.ops.histogram import plan_group_blocks
-    widths = (16,) * 100 + (256, 256) + (16,) * 50
-    chunk = 4096
-    blocks = plan_group_blocks(widths, chunk)
-    # contiguous full cover
-    nxt = 0
-    for gs, gc, bw in blocks:
-        assert gs == nxt and gc >= 1
-        assert bw >= max(widths[gs:gs + gc])
-        assert bw * gc * chunk <= (1 << 26)
-        nxt = gs + gc
-    assert nxt == len(widths)
-    # the leading narrow run is NOT scanned at 256
-    assert blocks[0][2] < 256
-    # uniform narrow config pays 16, not the global max
-    uniform = plan_group_blocks((16,) * 64, chunk)
-    assert all(bw == 16 for _, _, bw in uniform)
-
-
-def test_goss_device_weights_semantics():
-    """Device GOSS: top_rate rows by |g*h| always kept at weight 1; the
-    rest Bernoulli-sampled at the amplified weight (goss.hpp:87-131)."""
-    from lightgbm_tpu.boosting.goss import _goss_weights_device
-    rng = np.random.RandomState(0)
-    n, n_pad = 1000, 1024
-    g = np.zeros(n_pad, np.float32)
-    h = np.ones(n_pad, np.float32)
-    g[:n] = rng.randn(n)
-    top_k, other_k = 200, 100
-    w = np.asarray(_goss_weights_device(
-        jnp.asarray(g), jnp.asarray(h), seed=3, iter_idx=5, k=1,
-        n=n, n_pad=n_pad, top_k=top_k, other_k=other_k))
-    mag = np.abs(g[:n] * h[:n])
-    thresh = np.sort(mag)[-top_k]
-    assert (w[:n][mag >= thresh] == 1.0).all()
-    multiply = (n - top_k) / other_k
-    rest = w[:n][mag < thresh]
-    assert set(np.unique(rest)).issubset({0.0, np.float32(multiply)})
-    n_sampled = (rest > 0).sum()
-    assert 40 <= n_sampled <= 200   # E=100, Bernoulli
-    # padding rows never selected
-    assert (w[n:] == 0).all()
-    # deterministic per (seed, iter)
-    w2 = np.asarray(_goss_weights_device(
-        jnp.asarray(g), jnp.asarray(h), seed=3, iter_idx=5, k=1,
-        n=n, n_pad=n_pad, top_k=top_k, other_k=other_k))
-    np.testing.assert_array_equal(w, w2)
