@@ -93,9 +93,13 @@ def _contract_blocks(binned, row0, chunk, blocks, num_bins, u, bf16):
 
     u: [chunk, S] channel matrix (already masked/hi-lo-packed by the
     caller). Each block materializes only a [chunk, Gb, Bb] one-hot
-    (Bb = the block's own width) and its [Gb, Bb, S] product is padded
-    up to the uniform output width so downstream indexing is unchanged.
-    Returns [G, num_bins, S] f32."""
+    (Bb = the block's own width). Returns a TUPLE of per-block
+    [Gb, Bb, S] f32 parts at their OWN widths — the chunk loop
+    accumulates the ragged parts and only _assemble_blocks pads them to
+    the uniform output width once, after the loop. (Padding inside the
+    loop made the fori carry [G, Bmax, S]: on heavily-bundled data like
+    the Bosch shape that is ~3.5x the real bin mass, all of it read and
+    written every chunk step.)"""
     parts = []
     for gs, gc, bw in blocks:
         b_blk = jax.lax.dynamic_slice(binned, (row0, gs), (chunk, gc))
@@ -109,15 +113,46 @@ def _contract_blocks(binned, row0, chunk, blocks, num_bins, u, bf16):
                            u.astype(jnp.float32),
                            preferred_element_type=jnp.float32,
                            precision=jax.lax.Precision.HIGHEST)
+        parts.append(p)
+    return tuple(parts)
+
+
+def _blocks_zeros(blocks, num_bins, s):
+    return tuple(jnp.zeros((gc, min(bw, num_bins), s), jnp.float32)
+                 for _, gc, bw in blocks)
+
+
+def _assemble_blocks(parts, num_bins):
+    """Pad the ragged per-block accumulators to the uniform output width
+    and concatenate along the group axis: [G, num_bins, S]."""
+    out = []
+    for p in parts:
         if p.shape[1] < num_bins:
             p = jnp.pad(p, ((0, 0), (0, num_bins - p.shape[1]), (0, 0)))
-        parts.append(p)
-    return parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=0)
+        out.append(p)
+    return out[0] if len(out) == 1 else jnp.concatenate(out, axis=0)
 
 
 def _onehot(binned_chunk: jnp.ndarray, num_bins: int) -> jnp.ndarray:
     return (binned_chunk[:, :, None] ==
             jnp.arange(num_bins, dtype=binned_chunk.dtype)[None, None, :])
+
+
+def _accumulate_chunks(one, n_chunks, blocks, num_bins, s, n_valid, chunk):
+    """Shared chunk-accumulation scaffolding for both kernels: ragged
+    per-block carries through the fori_loop, assembled (padded to the
+    uniform width) once at the end."""
+    if n_chunks == 1:
+        return _assemble_blocks(one(jnp.int32(0)), num_bins)
+
+    def body(c, accs):
+        return tuple(a + p for a, p in zip(accs, one(c)))
+
+    trip = n_chunks if n_valid is None else \
+        jnp.minimum((n_valid + chunk - 1) // chunk, n_chunks)
+    init = _blocks_zeros(blocks, num_bins, s)
+    return _assemble_blocks(
+        jax.lax.fori_loop(0, trip, body, init), num_bins)
 
 
 @functools.partial(jax.jit, static_argnames=("num_bins", "chunk", "bf16",
@@ -171,16 +206,8 @@ def leaf_histogram(binned: jnp.ndarray, weights: jnp.ndarray,
         return _contract_blocks(binned, c * chunk, chunk, blocks,
                                 num_bins, u, bf16)
 
-    if n_chunks == 1:
-        hist = one(jnp.int32(0))
-    else:
-        def body(c, acc):
-            return acc + one(c)
-
-        trip = n_chunks if n_valid is None else \
-            jnp.minimum((n_valid + chunk - 1) // chunk, n_chunks)
-        init = jnp.zeros((f, num_bins, s), dtype=jnp.float32)
-        hist = jax.lax.fori_loop(0, trip, body, init)
+    hist = _accumulate_chunks(one, n_chunks, blocks, num_bins, s,
+                              n_valid, chunk)
     if bf16:
         hist = hist[:, :, 0:3].at[:, :, 0:2].add(hist[:, :, 3:5])
     return hist
@@ -240,16 +267,8 @@ def batched_leaves_histogram(binned: jnp.ndarray, weights: jnp.ndarray,
         return _contract_blocks(binned, c * chunk, chunk, blocks,
                                 num_bins, u, bf16)
 
-    if n_chunks == 1:
-        hist = one(jnp.int32(0))
-    else:
-        def body(c, acc):
-            return acc + one(c)
-
-        trip = n_chunks if n_valid is None else \
-            jnp.minimum((n_valid + chunk - 1) // chunk, n_chunks)
-        init = jnp.zeros((f, num_bins, s), dtype=jnp.float32)
-        hist = jax.lax.fori_loop(0, trip, body, init)
+    hist = _accumulate_chunks(one, n_chunks, blocks, num_bins, s,
+                              n_valid, chunk)
     if bf16:
         main = hist[:, :, :c_ids * 3].reshape(f, num_bins, c_ids, 3)
         corr = hist[:, :, c_ids * 3:].reshape(f, num_bins, c_ids, 2)
