@@ -129,6 +129,10 @@ def _greedy_find_bin(distinct_values: np.ndarray, counts: np.ndarray,
 
     c64 = counts.astype(np.int64)
     C = np.cumsum(c64)                       # C[i] = counts[0..i]
+    # float view for the searchsorted keys: a float key against the
+    # int64 array makes numpy promote (copy) the WHOLE array per call
+    # (~0.16 ms at 200k distinct, x~124 calls per feature)
+    Cf = C.astype(np.float64)
     Cnb = np.cumsum(np.where(is_big, 0, c64))  # non-big prefix
     big_idx = np.flatnonzero(is_big).tolist()  # sorted python list
     # candidates for the "next value is big" closure rule
@@ -155,7 +159,7 @@ def _greedy_find_bin(distinct_values: np.ndarray, counts: np.ndarray,
         # start (the sequential form closes at s in that state) — an
         # unclamped iB re-closed the previous bin and emitted duplicate
         # bounds (round-5 review finding, fuzz-reproduced)
-        iB = int(np.searchsorted(C, base + mean_bin_size, side="left"))
+        iB = int(np.searchsorted(Cf, base + mean_bin_size, side="left"))
         while iB - 1 >= s and cum(iB - 1, s) >= mean_bin_size:
             iB -= 1
         while iB < num_distinct and cum(min(iB, num_distinct - 1), s) < mean_bin_size:
@@ -163,7 +167,7 @@ def _greedy_find_bin(distinct_values: np.ndarray, counts: np.ndarray,
         iB = max(iB, s)
         # rule C: first i with is_big[i+1] and counts[s..i] >= half-mean
         half = max(1.0, mean_bin_size * 0.5)
-        i0 = int(np.searchsorted(C, base + half, side="left"))
+        i0 = int(np.searchsorted(Cf, base + half, side="left"))
         while i0 - 1 >= s and cum(i0 - 1, s) >= half:
             i0 -= 1
         while i0 < num_distinct and cum(min(i0, num_distinct - 1), s) < half:
