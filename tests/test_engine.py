@@ -391,3 +391,38 @@ def test_pipeline_stop_survives_midloop_finalize():
     assert True in stops, "stop signal was swallowed"
     assert len(booster._inner.models) == 0 or all(
         t.num_leaves > 1 for t in booster._inner.models)
+
+
+def test_pipeline_drains_before_explicit_gradient_update():
+    """Mixing pipelined updates with an explicit-gradient update (fobj)
+    must keep self.models in iteration order: the pending pipelined tree
+    drains BEFORE the fobj iteration appends its tree (round-5 review
+    finding)."""
+    import numpy as np
+
+    import lightgbm_tpu as lgb
+
+    rng = np.random.RandomState(2)
+    n = 2000
+    X = rng.randn(n, 5).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    params = {"objective": "binary", "verbose": -1, "num_leaves": 15,
+              "min_data_in_leaf": 5}
+    ds = lgb.Dataset(X, y, params=dict(params))
+    ds.construct()
+    booster = lgb.Booster(params=dict(params), train_set=ds)
+    booster.update()          # pipelined: tree 0 pending
+    booster.update()          # pipelined: tree 0 drained, tree 1 pending
+
+    def fobj(preds, train_data):
+        p = 1.0 / (1.0 + np.exp(-preds))
+        return p - y, p * (1 - p)
+
+    booster.update(fobj=fobj)  # must drain tree 1 FIRST, then append
+    booster._inner.finalize_training()
+    models = booster._inner.models
+    assert len(models) == 3
+    # iteration order: the fobj tree must be LAST; boosting is
+    # monotone-refining, so earlier trees have the larger value spread
+    spreads = [float(np.ptp(t.leaf_value)) for t in models]
+    assert spreads[0] >= spreads[2] * 0.5  # sanity: ordered, not swapped
