@@ -41,6 +41,61 @@ MAX_BIN = 63
 
 REPO = os.path.dirname(os.path.abspath(__file__))
 
+# backend-init retry schedule (relay-attached TPUs surface transient
+# UNAVAILABLE during worker restarts; a one-shot probe turns a 30 s blip
+# into a lost benchmark round)
+BACKEND_RETRIES = max(1, int(os.environ.get("BENCH_BACKEND_RETRIES", 4)))
+BACKEND_BACKOFF_S = float(os.environ.get("BENCH_BACKEND_BACKOFF", 5.0))
+
+_TRANSIENT_MARKERS = ("UNAVAILABLE", "DEADLINE_EXCEEDED", "failed to connect",
+                      "Connection reset", "Socket closed")
+
+
+def _init_backend_with_retry():
+    """Initialize the jax backend, retrying transient relay outages with
+    exponential backoff. On permanent outage, emit ONE machine-readable
+    diagnostic JSON line (the driver's contract is a JSON line per
+    metric — a raw traceback is unparseable) and exit nonzero."""
+    import traceback
+    delay = BACKEND_BACKOFF_S
+    last = None
+    last_tb = ""
+    attempt = 0
+    for attempt in range(1, BACKEND_RETRIES + 1):
+        try:
+            import jax
+            devs = jax.devices()
+            return [str(d) for d in devs]
+        except Exception as e:  # backend init failures are env-specific
+            last = e
+            last_tb = traceback.format_exc(limit=3)
+            msg = str(e)
+            transient = any(m in msg for m in _TRANSIENT_MARKERS)
+            if not transient or attempt == BACKEND_RETRIES:
+                break
+            print(json.dumps({
+                "event": "backend_retry", "attempt": attempt,
+                "sleep_seconds": delay,
+                "error": msg.splitlines()[0][:300] if msg else type(e).__name__,
+            }), flush=True)
+            time.sleep(delay)
+            delay *= 2
+    diag = {
+        "metric": "bench_backend_unavailable",
+        "value": None,
+        "unit": None,
+        "error": {
+            "type": type(last).__name__,
+            "message": str(last).splitlines()[0][:300] if str(last) else "",
+            "attempts": attempt,
+            "transient_markers": [m for m in _TRANSIENT_MARKERS
+                                  if m in str(last)],
+        },
+        "detail": {"traceback_tail": last_tb.splitlines()[-3:]},
+    }
+    print(json.dumps(diag), flush=True)
+    raise SystemExit(2)
+
 
 def synth_higgs(n, f, seed=0):
     """Synthetic HIGGS-like: dense float features, binary label from a
@@ -193,8 +248,8 @@ def run_shape(shape: str) -> dict:
         last[0] = now
 
     t0 = time.time()
-    lgb.train(dict(params), ds, num_boost_round=N_ITERS,
-              verbose_eval=False, callbacks=[_timer])
+    booster = lgb.train(dict(params), ds, num_boost_round=N_ITERS,
+                        verbose_eval=False, callbacks=[_timer])
     train_time = time.time() - t0
 
     steady = iter_times[1:] if len(iter_times) > 2 else iter_times
@@ -207,20 +262,36 @@ def run_shape(shape: str) -> dict:
     baseline = _baseline_for(shape)
     vs_baseline = (value / baseline) if baseline else 1.0
 
+    detail = {
+        "rows": n_rows, "features": int(X.shape[1]), "iters": N_ITERS,
+        "num_leaves": NUM_LEAVES, "max_bin": max_bin,
+        "categorical": len(cat_idx) if cat_idx else 0,
+        "train_seconds": round(train_time, 3),
+        "compile_seconds": round(compile_time, 3),
+        "steady_seconds_per_iter": round(steady_time, 4),
+        "mrow_iters_incl_trace": round(value_incl_trace, 4),
+    }
+    # pass economics (serial pipelined path records them per tree): the
+    # gather-compacted contraction shows up as rows_contracted well
+    # under passes * rows — the ratio is the realized late-tree discount
+    pass_log = getattr(getattr(booster, "_inner", None), "pass_log", None)
+    if pass_log:
+        tail = pass_log[-min(5, len(pass_log)):]
+        passes = sum(p[0] for p in tail) / len(tail)
+        rows_c = sum(p[2] for p in tail if len(p) > 2) / len(tail)
+        detail["passes_per_tree"] = round(passes, 1)
+        if rows_c:
+            detail["rows_contracted_per_tree"] = round(rows_c)
+            detail["full_pass_equivalent_rows"] = round(passes * n_rows)
+            detail["contraction_row_discount"] = round(
+                passes * n_rows / max(rows_c, 1.0), 3)
+
     return {
         "metric": f"{shape}_like_train_throughput",
         "value": round(value, 4),
         "unit": "mrow_iters/s",
         "vs_baseline": round(vs_baseline, 4),
-        "detail": {
-            "rows": n_rows, "features": int(X.shape[1]), "iters": N_ITERS,
-            "num_leaves": NUM_LEAVES, "max_bin": max_bin,
-            "categorical": len(cat_idx) if cat_idx else 0,
-            "train_seconds": round(train_time, 3),
-            "compile_seconds": round(compile_time, 3),
-            "steady_seconds_per_iter": round(steady_time, 4),
-            "mrow_iters_incl_trace": round(value_incl_trace, 4),
-        },
+        "detail": detail,
     }
 
 
@@ -262,6 +333,7 @@ def run_amortized(rows=None, iters=None) -> dict:
 
 
 def main():
+    _init_backend_with_retry()
     which = os.environ.get("BENCH_SHAPE", "higgs")
     if which == "amortized":
         print(json.dumps(run_amortized()), flush=True)

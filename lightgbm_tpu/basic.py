@@ -176,7 +176,17 @@ class Dataset:
                     # strings (the reference's contract)
                     cat_param = [c for c in cp[5:].split(",") if c != ""]
                 else:
-                    cat_param = [int(c) for c in cp.split(",") if c != ""]
+                    cat_param = []
+                    for c in cp.split(","):
+                        if c == "":
+                            continue
+                        try:
+                            cat_param.append(int(c))
+                        except ValueError:
+                            log.fatal(
+                                "categorical_column: cannot parse '%s' as "
+                                "a feature index; use integer indices or "
+                                "the name: prefix for feature names" % c)
             elif isinstance(cp, (int, np.integer)):
                 cat_param = [int(cp)]
             else:
@@ -186,8 +196,15 @@ class Dataset:
             for c in cat_param:
                 if isinstance(c, str) and feature_names and c in feature_names:
                     cat_indices.append(feature_names.index(c))
-                elif isinstance(c, int):
-                    cat_indices.append(c)
+                elif isinstance(c, (int, np.integer)):
+                    cat_indices.append(int(c))
+                elif isinstance(c, str):
+                    # the reference warns about unmatched names
+                    # (dataset_loader.cpp categorical handling) instead
+                    # of silently dropping them
+                    log.warning(
+                        "categorical_column entry '%s' does not match "
+                        "any feature name; ignored", c)
 
         label = self.label
         if label is not None:

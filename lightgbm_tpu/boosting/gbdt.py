@@ -86,7 +86,7 @@ _SMALL_STATE_KEYS = (
     "num_leaves_used", "leaf_value", "count", "node_feature",
     "node_threshold", "node_default_left", "node_is_cat", "node_left",
     "node_right", "node_gain", "node_value", "node_count", "num_passes",
-    "next_free", "comm_elems")
+    "next_free", "comm_elems", "rows_contracted", "pass_rows")
 
 
 class _HostState:
@@ -415,11 +415,39 @@ class GBDT:
         # the measured multiclass optimum is a smaller table
         table_mult = min(12, mult_fit) if subtract else \
             (6 if k_cls > 1 else 12)
+        # gather-compacted small-node contraction: on wherever rows are
+        # locally resident (serial + data/voting learners); the grower
+        # additionally refuses it under feature_axis. The threshold is a
+        # pure scheduling choice — for any value the grown trees match
+        # the full-pass grower on order-invariant sums (grow.py notes).
+        # Single-chunk runs have nothing to skip — the gather would only
+        # add a second compiled kernel per signature — so the
+        # auto-schedule keeps them on the full pass (measured: the win
+        # is already 2.3x at 2 chunks / 100k CPU rows, see
+        # profiles/README.md). Multiclass is excluded like subtraction:
+        # the vmap over class trees batches the per-pass cond predicate,
+        # which under jax's cond batching rule executes BOTH histogram
+        # kernels every pass — a strict pessimization.
+        # the grower re-guards on PER-SHARD rows (each shard compacts its
+        # own block), so gate on the same quantity or the schedule log
+        # would claim compact=True while the grower silently declines
+        shard_rows = self._n_pad
+        if self._tree_learner_kind in ("data", "voting"):
+            shard_rows = self._n_pad // max(
+                1, local_dev if nproc > 1 else ndev)
+        compact_frac = float(self.config.tree.tpu_compact_threshold)
+        compact = (self.config.tree.tpu_hist_compact
+                   and compact_frac > 0.0
+                   and self._tree_learner_kind != "feature"
+                   and k_cls == 1
+                   and shard_rows >= 2 * self._chunk)
         import os as _os
         if _os.environ.get("LGBM_TPU_TABLE_MULT"):      # debug override
             table_mult = int(_os.environ["LGBM_TPU_TABLE_MULT"])
         if _os.environ.get("LGBM_TPU_FORCE_SUBTRACT"):  # debug override
             subtract = _os.environ["LGBM_TPU_FORCE_SUBTRACT"] == "1"
+        if _os.environ.get("LGBM_TPU_FORCE_COMPACT"):   # debug override
+            compact = _os.environ["LGBM_TPU_FORCE_COMPACT"] == "1"
         if "tpu_batch_k" in self.config.raw_params:
             batch_k = self.config.tree.tpu_batch_k
         elif subtract:
@@ -436,14 +464,17 @@ class GBDT:
             bundled = g_cnt < 0.8 * max(1, train_data.num_features)
             batch_k = 4 if (wide and bundled) else 12
         log.info("Schedule: groups=%d max_bin=%d wide=%s subtract=%s "
-                 "batch_k=%d table_mult=%d chunk=%d", g_cnt, self._max_bins,
-                 wide, subtract, batch_k, table_mult, self._chunk)
+                 "compact=%s@%.2f batch_k=%d table_mult=%d chunk=%d",
+                 g_cnt, self._max_bins, wide, subtract, compact,
+                 compact_frac, batch_k, table_mult, self._chunk)
         self._grower_cfg = GrowerConfig(
             num_leaves=self.config.tree.num_leaves,
             max_bins=self._max_bins,
             feature_bins=int(train_data.num_bins_per_feature().max(initial=1)),
             batch_k=batch_k,
             hist_subtract=subtract,
+            hist_compact=compact,
+            compact_fraction=compact_frac,
             table_mult=table_mult,
             hist_bf16=self.config.tree.tpu_hist_bf16,
             chunk=self._chunk,
@@ -780,6 +811,11 @@ class GBDT:
         from ..learner.grow import FMETA_KEYS
 
         if getattr(self, "_stopped", False):
+            # report the pending stop ONCE, then drop the latch: the
+            # reference retries every TrainOneIter call (a fresh bag can
+            # open splits the previous one closed), so a later call must
+            # be allowed to train again (ADVICE.md round 5 #1)
+            self._stopped = False
             return True
         mask = self._feature_mask()
         with tracing.phase("tree/grow"):
@@ -812,6 +848,11 @@ class GBDT:
                 neg.leaf_value = -neg.leaf_value
                 self._score = self._score.at[0].add(
                     predict_value_binned(neg.to_device(), self._binned))
+            # the stop is reported by THIS return — disarm the latch so
+            # the next call trains again (the latch only needs to carry
+            # a stop detected by an out-of-band drain, e.g. an eval's
+            # finalize_training, to the next train_one_iter)
+            self._stopped = False
             return True
         return False
 
@@ -832,11 +873,18 @@ class GBDT:
                 tree.add_bias(self._pending_bias)
                 self._pending_bias = 0.0
                 self.init_score_bias = 0.0
-        # schedule observability (scripts/profile_train.py + PARITY.md)
+        # schedule observability (scripts/profile_train.py + PARITY.md):
+        # (passes, table high-water, rows fed to histogram contractions)
+        # per tree — the last entry is the compaction economics headline
+        # (full passes report ~passes * N)
         if not hasattr(self, "pass_log"):
             self.pass_log = []
+        rows_contracted = float(getattr(host_state, "rows_contracted", 0.0))
         self.pass_log.append((int(host_state.num_passes),
-                              int(host_state.next_free)))
+                              int(host_state.next_free),
+                              rows_contracted))
+        tracing.counter("tree/num_passes", int(host_state.num_passes))
+        tracing.counter("tree/rows_contracted", rows_contracted)
         return tree
 
     def _flush_pending(self) -> bool:
@@ -849,6 +897,11 @@ class GBDT:
         tree = self._materialize_small(small, shrink)
         if tree.num_leaves > 1:
             self.models.append(tree)
+            # a splitting tree clears any stale stop latch: the latch
+            # exists to carry a pending stop across a drain, not to
+            # poison later successful iterations (a fresh bag can open
+            # splits a previous bag closed — ADVICE.md round 5 #1)
+            self._stopped = False
             return True
         self.iter_ -= 1
         # latch the stop so a drain from finalize_training (e.g. a
@@ -885,6 +938,9 @@ class GBDT:
             log.warning("Stopped training because there are no more leaves "
                         "that meet the split requirements")
             return True
+        # sync-path iterations that split clear the pipelined stop latch
+        # (same rationale as in _flush_pending)
+        self._stopped = False
         return False
 
     def _train_one_iter_multi(self, grad, hess, row_weight) -> bool:
@@ -968,6 +1024,11 @@ class GBDT:
         return len(self.models)
 
     def current_iteration(self) -> int:
+        # drain the async pipeline like num_trees(): mid-pipeline the
+        # counter could name an iteration whose tree later fails to
+        # split and is rolled back (non-monotonic, inconsistent with
+        # num_trees — ADVICE.md round 5 #2)
+        self.finalize_training()
         return self.iter_
 
     # ------------------------------------------------------------------

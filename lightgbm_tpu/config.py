@@ -182,6 +182,20 @@ class TreeConfig:
     # child's histogram per expansion. Auto-disabled when the cache
     # would exceed its device-memory budget (boosting/gbdt.py).
     tpu_hist_subtract: bool = True
+    # gather-compacted small-node contraction (learner/grow.py): when
+    # one expansion pass's selected nodes jointly hold at most
+    # tpu_compact_threshold * N in-bag rows, compact their row indices
+    # and contract only the gathered subset — late-tree passes then cost
+    # O(rows-in-selected-nodes) instead of O(N) (the reference's
+    # DataPartition economics, data_partition.hpp:94-170). On for the
+    # serial and data/voting-parallel learners; the feature-parallel
+    # learner ignores it (routing reads the replicated matrix through a
+    # traced shard offset)
+    tpu_hist_compact: bool = True
+    # switch threshold and compaction-buffer capacity as a row fraction
+    # (rounded up to a chunk multiple; >= 1.0 forces compaction,
+    # <= 0 disables it)
+    tpu_compact_threshold: float = 0.25
     # RETIRED (accepted for compat, warns): the hand-written pallas
     # histogram kernel measured slower than XLA's own fusion of the
     # one-hot compare into the dot (14.4 vs 11.1 ms/pass at 2M x 28 x 64)

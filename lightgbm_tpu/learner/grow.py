@@ -63,6 +63,27 @@ Pass count drops from ~(commits / 2.8) to ~max(tree depth, commits / K):
 measured 91 -> ~30 per 255-leaf tree (batch_k=12, round 3), ~20 with
 subtraction's batch_k=24.
 
+Gather-compacted small-node contraction (round 6, `hist_compact`): pass
+COUNT optimization leaves a per-pass O(N) floor — late in a tree the
+selected nodes hold ~1% of the rows yet the full-pass kernel still
+contracts every chunk, so an amortized 500-iteration run spends most of
+its histogram time on rows that land in no live channel. The reference
+never pays this: its DataPartition keeps per-leaf index lists and
+histogram cost tracks the leaf (serial_tree_learner.cpp:349-363,
+data_partition.hpp:94-170). Here, when a pass's selected nodes jointly
+hold at most compact_fraction*N in-bag rows (they are exactly the rows
+relabeled this pass, so membership is ONE compare against the
+allocation pointer), their indices are compacted by a stable cumsum
+scatter into a fixed-capacity chunk-multiple buffer and the SAME
+contraction runs over the gathered subset with a dynamic trip count
+(ops/histogram.gathered_leaves_histogram) — shapes stay compile-stable,
+and per-pass cost drops to O(rows-in-selected-nodes). Selection,
+routing, and split scans are unchanged, so trees keep the
+bit-identical-to-sequential guarantee on order-invariant sums (the
+gather only reorders f32 partial sums, like subtraction). The
+`rows_contracted` / `pass_rows` counters record the realized economics
+next to `num_passes`.
+
 `num_leaves-1` commits, one compile per (N, F, B, L, hyperparam)
 signature, reused across trees and boosting iterations.
 """
@@ -148,6 +169,27 @@ class GrowerConfig(NamedTuple):
     # budget allows: generous tables keep late-boosting (flat-gain)
     # speculation wide — see the table-exhaustion notes in expand().
     table_mult: int = 6
+    # gather-compacted small-node contraction (reference economics:
+    # serial_tree_learner.cpp:349-363 + data_partition.hpp:94-170 —
+    # per-node histogram cost tracks the LEAF's row count, not N): when
+    # the nodes selected for one expansion pass jointly hold at most
+    # compact_fraction*N in-bag rows, their row indices are compacted
+    # device-side (stable cumsum scatter) into a fixed-capacity padded
+    # buffer and the pass contracts only the gathered subset
+    # (ops/histogram.gathered_leaves_histogram). Off by default at this
+    # layer so raw grow_tree calls keep their exact summation order;
+    # the GBDT layer turns it on for the serial/data-parallel learners
+    # (f32 gather-order differences are the same class of reordering
+    # subtraction already introduces — trees stay bit-identical on
+    # order-invariant sums, see tests/test_grower_batching.py).
+    # Disabled under feature parallelism: routing there reads the
+    # replicated matrix through a traced per-shard feature offset, so a
+    # compacted gather cannot keep a static group-width plan.
+    hist_compact: bool = False
+    # switch threshold AND buffer capacity, as a fraction of N (rounded
+    # up to a chunk multiple; >= 1.0 forces every pass through the
+    # compacted path — useful for tests; <= 0 disables compaction)
+    compact_fraction: float = 0.25
 
 
 class TreeGrowerState(NamedTuple):
@@ -165,6 +207,14 @@ class TreeGrowerState(NamedTuple):
                                   # (speculation-waste observability)
     comm_elems: jnp.ndarray       # scalar f32: elements moved through
                                   # cross-shard collectives this tree
+    rows_contracted: jnp.ndarray  # scalar f32: rows fed to histogram
+                                  # contractions this tree (global under
+                                  # data_axis); the old full-pass
+                                  # economics report ~num_passes * N,
+                                  # the compacted path far less
+    pass_rows: jnp.ndarray        # [4L+64] i32 rows contracted per pass
+                                  # (index = pass number; compaction
+                                  # observability)
     # tree node arrays [L-1]
     node_feature: jnp.ndarray
     node_threshold: jnp.ndarray
@@ -424,6 +474,8 @@ class _Carry(NamedTuple):
     next_free: jnp.ndarray        # scalar i32 allocation pointer
     num_passes: jnp.ndarray
     comm_elems: jnp.ndarray
+    rows_contracted: jnp.ndarray  # scalar f32 (local to this shard)
+    pass_rows: jnp.ndarray        # [4L+64] i32 per-pass contracted rows
     # [M, G, B, 3] per-node group histograms (hist_subtract only; [0]
     # placeholder otherwise) — the HistogramPool analogue
     hist_cache: jnp.ndarray
@@ -553,6 +605,25 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     # parent-minus-child identity) so it keeps the direct 2K-children path
     subtract = cfg.hist_subtract and not voting
 
+    # gather-compacted small-node contraction: static buffer capacity =
+    # compact_fraction of the (per-shard) row count, rounded UP to a
+    # chunk multiple and clamped to n, so every shape in the while_loop
+    # stays compile-stable. The capacity doubles as the switch
+    # threshold: a pass is compacted iff its selected nodes' in-bag
+    # member rows fit the buffer.
+    # single-chunk (per-shard) inputs have no chunks to skip: cap would
+    # round up to n and force EVERY pass through the slower gather —
+    # keep the contiguous full-pass kernel there. A non-positive
+    # fraction disables compaction (mirroring >= 1.0 forcing it on).
+    compact = bool(cfg.hist_compact) and cfg.feature_axis is None \
+        and float(cfg.compact_fraction) > 0.0 \
+        and n % cfg.chunk == 0 and n >= 2 * cfg.chunk
+    if compact:
+        cap = max(1, int(n * min(float(cfg.compact_fraction), 1.0)))
+        cap = min(n, ((cap + cfg.chunk - 1) // cfg.chunk) * cfg.chunk)
+        compact = cap >= cfg.chunk
+    pass_cap = 4 * L + 64   # == the round_cond hard pass cap
+
     # --- root (BeforeTrain: serial_tree_learner.cpp:234-323) ------------
     root_hist = reduce_hist(
         hist_ops.leaf_histogram(local_binned, w3, B, cfg.chunk,
@@ -605,12 +676,19 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         hist_cache = jnp.zeros((1,), jnp.float32)
 
     neg_inf = jnp.float32(-jnp.inf)
+    # rows the root pass contracted (the full-pass kernels skip whole
+    # all-padding chunks via n_valid, so count only the real rows)
+    full_rows = jnp.float32(n) if nv_local is None \
+        else nv_local.astype(jnp.float32)
     carry = _Carry(
         leaf_id=jnp.zeros(n, jnp.int32),
         table=table,
         next_free=jnp.int32(1),
         num_passes=jnp.int32(1),
         comm_elems=root_comm,
+        rows_contracted=full_rows,
+        pass_rows=jnp.zeros(pass_cap, jnp.int32).at[0].set(
+            full_rows.astype(jnp.int32)),
         hist_cache=hist_cache,
         sum_g=jnp.zeros(L, jnp.float32).at[0].set(root_g),
         sum_h=jnp.zeros(L, jnp.float32).at[0].set(root_h),
@@ -760,10 +838,50 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
 
         leaf_id = route(carry.leaf_id, lambda grp: jax.lax.dynamic_slice(
             binned_T, (grp, 0), (1, n))[0])
-        hists = reduce_hist(hist_ops.batched_leaves_histogram(
-            local_binned, w3, leaf_id, hist_ids, B, cfg.chunk,
-            bf16=cfg.hist_bf16, n_valid=nv_local,
-            group_widths=gw))
+
+        if compact:
+            # member rows of THIS pass's selected nodes are exactly the
+            # rows just relabeled to fresh child ids — every id >=
+            # next_free is new this pass (the allocation pointer is
+            # monotone), so membership is one compare, no K-loop.
+            # Zero-weight (out-of-bag / padding) rows contribute zero to
+            # every channel either way; excluding them keeps small
+            # bagged nodes inside the buffer.
+            member = (leaf_id >= carry.next_free) & (w3[:, 2] > 0.0)
+            cnt = jnp.sum(member.astype(jnp.int32))
+            use_compact = cnt <= cap
+
+            def gathered(_):
+                # stable compaction: cumsum ranks keep row order, so the
+                # gathered chunks sum rows in their original relative
+                # order. Built INSIDE the branch: cond executes only the
+                # taken side, so full passes skip the cumsum + scatter.
+                pos = jnp.cumsum(member.astype(jnp.int32)) - 1
+                rows_buf = jnp.zeros(cap, jnp.int32).at[
+                    jnp.where(member, pos, cap)].set(
+                        jnp.arange(n, dtype=jnp.int32), mode="drop")
+                return hist_ops.gathered_leaves_histogram(
+                    local_binned, w3, leaf_id, rows_buf, hist_ids, B,
+                    cfg.chunk, bf16=cfg.hist_bf16, n_valid=cnt,
+                    group_widths=gw)
+
+            hists = jax.lax.cond(
+                use_compact,
+                gathered,
+                lambda _: hist_ops.batched_leaves_histogram(
+                    local_binned, w3, leaf_id, hist_ids, B, cfg.chunk,
+                    bf16=cfg.hist_bf16, n_valid=nv_local,
+                    group_widths=gw),
+                None)
+            rows_pass = jnp.where(use_compact, cnt.astype(jnp.float32),
+                                  full_rows)
+        else:
+            hists = hist_ops.batched_leaves_histogram(
+                local_binned, w3, leaf_id, hist_ids, B, cfg.chunk,
+                bf16=cfg.hist_bf16, n_valid=nv_local,
+                group_widths=gw)
+            rows_pass = full_rows
+        hists = reduce_hist(hists)
 
         if subtract:
             # larger child = parent - smaller (the cache holds every
@@ -829,6 +947,9 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             leaf_id=leaf_id, table=t, next_free=next_free,
             num_passes=carry.num_passes + 1,
             comm_elems=carry.comm_elems + comm,
+            rows_contracted=carry.rows_contracted + rows_pass,
+            pass_rows=carry.pass_rows.at[carry.num_passes].set(
+                rows_pass.astype(jnp.int32), mode="drop"),
             hist_cache=hist_cache)
 
     # --- commit (Train: serial_tree_learner.cpp:152-205) ----------------
@@ -958,6 +1079,17 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     slot_map = jnp.clip(slot_map, 0, L - 1)
     leaf_slot_of_row = slot_map[jnp.clip(carry.leaf_id, 0, M - 1)]
 
+    # the contraction counters are per-shard (each shard compacts its own
+    # rows and may even take a different path per pass); sum them once so
+    # the returned observability state is GLOBAL and truly replicated —
+    # the distributed learners' out_specs mark all non-leaf_id state
+    # replicated (parallel/learners.py)
+    rows_contracted = carry.rows_contracted
+    pass_rows = carry.pass_rows
+    if cfg.data_axis is not None:
+        rows_contracted = jax.lax.psum(rows_contracted, cfg.data_axis)
+        pass_rows = jax.lax.psum(pass_rows, cfg.data_axis)
+
     return TreeGrowerState(
         leaf_id=leaf_slot_of_row,
         sum_g=carry.sum_g, sum_h=carry.sum_h, count=carry.count,
@@ -965,6 +1097,7 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         leaf_parent=carry.leaf_parent,
         num_passes=carry.num_passes, next_free=carry.next_free,
         comm_elems=carry.comm_elems,
+        rows_contracted=rows_contracted, pass_rows=pass_rows,
         node_feature=carry.node_feature,
         node_threshold=carry.node_threshold,
         node_default_left=carry.node_default_left,

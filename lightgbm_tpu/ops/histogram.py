@@ -33,6 +33,13 @@ Two performance levers over the naive contraction:
   grower sizes 2K*(3+2) to fill the tile (batch_k=12) — extra slots
   are free, and the per-pass cost sits at ~70% of the bf16 matmul
   roofline (profiles/README.md).
+- `gathered_leaves_histogram` breaks the remaining O(N)-per-pass floor
+  for SMALL nodes: late in a tree the expanded nodes hold ~1% of the
+  rows, yet the full-pass kernels still contract every chunk. The
+  grower compacts the member rows' indices into a fixed-capacity
+  buffer and this kernel contracts only the gathered subset — per-node
+  work scales with node size, the economics of the reference's
+  DataPartition leaf index lists (data_partition.hpp:94-170).
 """
 from __future__ import annotations
 
@@ -88,9 +95,13 @@ def plan_group_blocks(group_widths, chunk: int,
     return tuple(blocks)
 
 
-def _contract_blocks(binned, row0, chunk, blocks, num_bins, u, bf16):
+def _contract_block_parts(get_block, blocks, num_bins, u, bf16):
     """One row-chunk's histogram contribution, group-block tiled.
 
+    get_block(gs, gc): returns the chunk's [chunk, gc] bin slice for the
+    group block starting at gs — a dynamic slice of the resident bin
+    matrix for the full-pass kernels, a static slice of an already
+    gathered chunk for the compacted kernel.
     u: [chunk, S] channel matrix (already masked/hi-lo-packed by the
     caller). Each block materializes only a [chunk, Gb, Bb] one-hot
     (Bb = the block's own width). Returns a TUPLE of per-block
@@ -102,8 +113,7 @@ def _contract_blocks(binned, row0, chunk, blocks, num_bins, u, bf16):
     written every chunk step.)"""
     parts = []
     for gs, gc, bw in blocks:
-        b_blk = jax.lax.dynamic_slice(binned, (row0, gs), (chunk, gc))
-        oh = _onehot(b_blk, min(bw, num_bins))
+        oh = _onehot(get_block(gs, gc), min(bw, num_bins))
         if bf16:
             p = jnp.einsum("cfb,cs->fbs", oh.astype(jnp.bfloat16),
                            u.astype(jnp.bfloat16),
@@ -115,6 +125,13 @@ def _contract_blocks(binned, row0, chunk, blocks, num_bins, u, bf16):
                            precision=jax.lax.Precision.HIGHEST)
         parts.append(p)
     return tuple(parts)
+
+
+def _contract_blocks(binned, row0, chunk, blocks, num_bins, u, bf16):
+    return _contract_block_parts(
+        lambda gs, gc: jax.lax.dynamic_slice(binned, (row0, gs),
+                                             (chunk, gc)),
+        blocks, num_bins, u, bf16)
 
 
 def _blocks_zeros(blocks, num_bins, s):
@@ -269,6 +286,83 @@ def batched_leaves_histogram(binned: jnp.ndarray, weights: jnp.ndarray,
 
     hist = _accumulate_chunks(one, n_chunks, blocks, num_bins, s,
                               n_valid, chunk)
+    if bf16:
+        main = hist[:, :, :c_ids * 3].reshape(f, num_bins, c_ids, 3)
+        corr = hist[:, :, c_ids * 3:].reshape(f, num_bins, c_ids, 2)
+        hist = (main.at[:, :, :, 0:2].add(corr)
+                .reshape(f, num_bins, c_ids * 3))
+    return hist.reshape(f, num_bins, c_ids, 3).transpose(2, 0, 1, 3)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_bins", "chunk", "bf16",
+                                    "group_widths"))
+def gathered_leaves_histogram(binned: jnp.ndarray, weights: jnp.ndarray,
+                              leaf_id: jnp.ndarray, rows: jnp.ndarray,
+                              ids: jnp.ndarray, num_bins: int,
+                              chunk: int = 16384, bf16: bool = True,
+                              n_valid=None,
+                              group_widths=None) -> jnp.ndarray:
+    """batched_leaves_histogram over a COMPACTED row subset.
+
+    `rows` is a fixed-capacity [cap] i32 buffer of row indices into
+    `binned` (cap a static multiple of `chunk`, so shapes stay
+    compile-stable inside the grower's while_loop); only the first
+    `n_valid` entries are real — the speculative grower packs the member
+    rows of the selected expansion nodes with a cumsum-stable compaction
+    (learner/grow.py) when those nodes jointly hold a small row
+    fraction. Each chunk gathers its bin rows and weight channels
+    through the index buffer and feeds the SAME one-hot contraction as
+    batched_leaves_histogram, so the per-pass cost is O(rows-in-
+    selected-nodes), not O(N) — the accelerator analogue of the
+    reference's per-leaf index lists (data_partition.hpp:94-170), where
+    histogram cost tracks the leaf, not the dataset.
+
+    n_valid contract here differs from the full-pass kernels: buffer
+    slots beyond n_valid alias row 0 (the compaction scatters real
+    indices only), so the boundary chunk MASKS channels of dead slots to
+    zero — the dynamic trip count then skips whole all-padding chunks
+    for free, exactly like the padded-row suffix of the full pass.
+
+    Returns [C, F, B, 3] like batched_leaves_histogram.
+    """
+    cap = rows.shape[0]
+    f = binned.shape[1]
+    if cap % chunk != 0:
+        raise ValueError(
+            f"row buffer ({cap}) must be a multiple of chunk ({chunk})")
+    c_ids = ids.shape[0]
+    n_chunks = cap // chunk
+    widths = group_widths if group_widths else (num_bins,) * f
+    blocks = plan_group_blocks(widths, chunk)
+    s = c_ids * 5 if bf16 else c_ids * 3
+    nv = jnp.int32(cap) if n_valid is None else \
+        jnp.minimum(jnp.asarray(n_valid, jnp.int32), cap)
+
+    def one(c):
+        r = jax.lax.dynamic_slice(rows, (c * chunk,), (chunk,))
+        live = (c * chunk + jnp.arange(chunk, dtype=jnp.int32)) < nv
+        w_chunk = jnp.where(live[:, None], weights[r], 0.0)
+        b_rows = binned[r]                                     # [chunk, F]
+        member = (leaf_id[r][:, None] == ids[None, :]) \
+            & live[:, None]                                    # [C, K]
+        if bf16:
+            hi, lo = _hi_lo(w_chunk)
+            mb = member[:, :, None].astype(jnp.bfloat16)
+            u_hi = (mb * hi[:, None, :]).reshape(chunk, c_ids * 3)
+            u_lo = (mb[:, :, 0:2] * lo[:, None, 0:2]).reshape(chunk,
+                                                              c_ids * 2)
+            u = jnp.concatenate([u_hi, u_lo], axis=1)
+        else:
+            u = (member[:, :, None].astype(jnp.float32)
+                 * w_chunk[:, None, :]).reshape(chunk, c_ids * 3)
+        return _contract_block_parts(
+            lambda gs, gc: jax.lax.slice_in_dim(b_rows, gs, gs + gc,
+                                                axis=1),
+            blocks, num_bins, u, bf16)
+
+    hist = _accumulate_chunks(one, n_chunks, blocks, num_bins, s,
+                              nv, chunk)
     if bf16:
         main = hist[:, :, :c_ids * 3].reshape(f, num_bins, c_ids, 3)
         corr = hist[:, :, c_ids * 3:].reshape(f, num_bins, c_ids, 2)

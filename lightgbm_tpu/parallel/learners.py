@@ -36,6 +36,18 @@ from .. import log
 from ..learner.grow import GrowerConfig, grow_tree
 
 
+def shard_map_compat(f, mesh, in_specs, out_specs):
+    """jax.shard_map across jax versions: the top-level binding (with
+    check_vma) only exists on newer jax; older releases ship it as
+    jax.experimental.shard_map.shard_map (with check_rep)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as _sm
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=False)
+
+
 def make_mesh(num_devices: Optional[int] = None, axis_name: str = "data",
               devices=None) -> Mesh:
     """1-D mesh over the available devices (reference analogue: the machine
@@ -97,14 +109,13 @@ class DataParallelGrower:
         # row count, so one shard_map signature serves both
         if n_valid is None:
             n_valid = binned.shape[0]
-        run = jax.shard_map(
+        run = shard_map_compat(
             lambda b, g, h, w, fm, nv, *meta: grow_tree(
                 b, g, h, w, fm, *meta, cfg, n_valid=nv),
             mesh=self.mesh,
             in_specs=(P(ax, None), P(ax), P(ax), P(ax), P(None), P())
                      + (P(None),) * 7,
-            out_specs=state_spec,
-            check_vma=False)
+            out_specs=state_spec)
         return run(binned, grad, hess, row_weight, feature_mask,
                    jnp.int32(n_valid), *[fmeta[k] for k in FMETA_KEYS])
 
@@ -158,14 +169,13 @@ class FeatureParallelGrower:
         state_spec = TreeGrowerState(**fields)
         if n_valid is None:
             n_valid = binned.shape[0]
-        run = jax.shard_map(
+        run = shard_map_compat(
             lambda b, g, h, w, fm, nv, *meta: grow_tree(
                 b, g, h, w, fm, *meta, cfg, n_valid=nv),
             mesh=self.mesh,
             in_specs=(P(None, None), P(None), P(None), P(None), P(None),
                       P()) + (P(None),) * 7,
-            out_specs=state_spec,
-            check_vma=False)
+            out_specs=state_spec)
         return run(binned, grad, hess, row_weight, feature_mask,
                    jnp.int32(n_valid), *[fmeta[k] for k in FMETA_KEYS])
 

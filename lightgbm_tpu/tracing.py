@@ -25,6 +25,8 @@ from . import log
 
 _totals: Dict[str, float] = defaultdict(float)
 _counts: Dict[str, int] = defaultdict(int)
+_counters: Dict[str, float] = defaultdict(float)
+_counter_events: Dict[str, int] = defaultdict(int)
 _enabled = os.environ.get("LGBM_TPU_TIMETAG", "") not in ("", "0", "false")
 
 
@@ -40,10 +42,25 @@ def enabled() -> bool:
 def reset() -> None:
     _totals.clear()
     _counts.clear()
+    _counters.clear()
+    _counter_events.clear()
 
 
 def totals() -> Dict[str, Tuple[float, int]]:
     return {k: (_totals[k], _counts[k]) for k in _totals}
+
+
+def counter(name: str, value: float) -> None:
+    """Accumulate a numeric event counter (e.g. histogram passes, rows
+    contracted) next to the phase timers; dumped with them. Zero-cost
+    when tracing is disabled."""
+    if _enabled:
+        _counters[name] += float(value)
+        _counter_events[name] += 1
+
+
+def counters() -> Dict[str, Tuple[float, int]]:
+    return {k: (_counters[k], _counter_events[k]) for k in _counters}
 
 
 @contextlib.contextmanager
@@ -76,11 +93,18 @@ def block(x):
 def dump() -> None:
     """Log accumulated phase times (reference: the TIMETAG destructor
     printout, gbdt.cpp:53-62)."""
-    if not _totals:
+    if not _totals and not _counters:
         return
-    log.info("=== phase timers ===")
-    for name in sorted(_totals, key=_totals.get, reverse=True):
-        log.info("%-28s %8.3f s  x%d", name, _totals[name], _counts[name])
+    if _totals:
+        log.info("=== phase timers ===")
+        for name in sorted(_totals, key=_totals.get, reverse=True):
+            log.info("%-28s %8.3f s  x%d", name, _totals[name],
+                     _counts[name])
+    if _counters:
+        log.info("=== counters ===")
+        for name in sorted(_counters, key=_counters.get, reverse=True):
+            log.info("%-28s %12.0f  x%d", name, _counters[name],
+                     _counter_events[name])
 
 
 @contextlib.contextmanager

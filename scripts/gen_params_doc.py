@@ -95,7 +95,18 @@ DESCRIPTIONS = {
     "tpu_hist_subtract": "sibling-subtraction histogram cache (build "
                          "the smaller child, derive the larger); "
                          "auto-disabled when the cache exceeds budget",
-    "tpu_hist_pallas": "opt-in fused pallas histogram kernel",
+    "tpu_hist_compact": "gather-compacted small-node histogram passes: "
+                        "when the nodes expanded in one pass jointly "
+                        "hold few rows, contract only their gathered "
+                        "rows instead of the full dataset (ignored by "
+                        "the feature-parallel learner)",
+    "tpu_compact_threshold": "row fraction below which a pass takes the "
+                             "compacted path (also sizes the gather "
+                             "buffer; >= 1.0 forces compaction, <= 0 "
+                             "disables it)",
+    "tpu_hist_pallas": "retired; accepted for compatibility, warns and "
+                       "uses the XLA path (see profiles/README.md "
+                       "postmortem)",
     # boosting
     "num_iterations": "boosting rounds",
     "learning_rate": "shrinkage applied to each tree",

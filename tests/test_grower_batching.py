@@ -142,6 +142,104 @@ def test_subtraction_with_bagging_weights():
     _assert_same_tree(base, both)
 
 
+@pytest.mark.parametrize("frac", [0.25, 1.0])
+@pytest.mark.parametrize("subtract", [False, True])
+def test_compaction_identical_trees(frac, subtract):
+    """The gather-compacted path (hist_compact) contracts only the
+    selected nodes' member rows; on order-invariant sums the grown tree
+    must be bit-identical to the full-pass grower for ANY threshold —
+    each case compares against the path fully OFF: 0.25 is the default
+    switch (mixed full/compacted passes), 1.0 forces EVERY pass through
+    the gather — and composed with sibling subtraction."""
+    ds, g, h, _ = _int_friendly_case()
+    base = _grow_cfg(ds, g, h, batch_k=8, hist_subtract=subtract)
+    comp = _grow_cfg(ds, g, h, batch_k=8, hist_subtract=subtract,
+                     hist_compact=True, compact_fraction=frac)
+    _assert_same_tree(base, comp)
+    assert int(base.num_leaves_used) > 10
+    if frac >= 1.0:
+        # forced: every expansion pass gathered, so the total contracted
+        # rows must undercut the full-pass economics
+        assert float(comp.rows_contracted) < float(base.rows_contracted)
+
+
+def test_compaction_with_bagging_weights():
+    """Zero-weight (out-of-bag) rows are EXCLUDED from the compaction
+    buffer (they contribute zero to every channel either way), so bagged
+    nodes compact earlier; trees must stay bit-identical."""
+    ds, g, h, w = _int_friendly_case(bag=True)
+    base = _grow_cfg(ds, g, h, weight=w, batch_k=8)
+    comp = _grow_cfg(ds, g, h, weight=w, batch_k=8,
+                     hist_compact=True, compact_fraction=1.0)
+    _assert_same_tree(base, comp)
+    both = _grow_cfg(ds, g, h, weight=w, batch_k=8, hist_subtract=True,
+                     hist_compact=True)
+    _assert_same_tree(base, both)
+
+
+def test_compaction_efb_group_widths():
+    """The gathered kernel must honor the same static group-width block
+    plan as the full-pass kernels: one-hot exclusive feature blocks
+    bundle under EFB, giving a stored-group matrix with heterogeneous
+    widths."""
+    rng = np.random.RandomState(13)
+    n, blocks = 2048, 6
+    X = np.zeros((n, blocks * 8 + 4), np.float32)
+    for b in range(blocks):  # one-hot blocks: EFB bundles each to 1 group
+        pick = rng.randint(0, 8, size=n)
+        X[np.arange(n), b * 8 + pick] = rng.rand(n).astype(np.float32) + 0.1
+    X[:, blocks * 8:] = rng.randn(n, 4).astype(np.float32)
+    y = (X[:, 0] - X[:, 9] + X[:, blocks * 8] * 2
+         + 0.1 * rng.randn(n)).astype(np.float32)
+    ds = lgb.basic.Dataset(X, y)._lazy_init()
+    assert ds.num_groups < ds.num_features  # bundling actually happened
+    gw = tuple(int(b) for b in ds.groups.group_num_bin)
+    g = jnp.asarray(np.round(-y * 4) / 4)
+    h = jnp.ones_like(g)
+    base = _grow_cfg(ds, g, h, num_leaves=31, batch_k=8, group_widths=gw)
+    comp = _grow_cfg(ds, g, h, num_leaves=31, batch_k=8, group_widths=gw,
+                     hist_compact=True, compact_fraction=1.0)
+    _assert_same_tree(base, comp)
+    assert int(base.num_leaves_used) > 5
+
+
+def test_rows_contracted_economics_on_deep_tree():
+    """On a deep 255-leaf tree the compacted path's late passes contract
+    an ever-shrinking row count: the `rows_contracted`/`pass_rows`
+    counters must show the full-pass grower at exactly passes * N while
+    the compacted grower undercuts it, with a strictly decreasing tail
+    of small passes summing to less than N/2 (the late-tree regime the
+    optimization exists for)."""
+    rng = np.random.RandomState(11)
+    n, f = 8192, 10
+    X = np.asarray(rng.randn(n, f), np.float32)
+    y = rng.randn(n).astype(np.float32)
+    ds = lgb.basic.Dataset(X, y)._lazy_init()
+    g = jnp.asarray(np.round(-y * 4) / 4)
+    h = jnp.ones_like(g)
+    base = _grow_cfg(ds, g, h, batch_k=8, num_leaves=255,
+                     hist_subtract=True)
+    comp = _grow_cfg(ds, g, h, batch_k=8, num_leaves=255,
+                     hist_subtract=True, hist_compact=True)
+    _assert_same_tree(base, comp)
+    assert int(comp.num_leaves_used) == 255
+    passes = int(comp.num_passes)
+    # old economics: every pass contracts all N rows
+    assert int(base.rows_contracted) == int(base.num_passes) * n
+    # new economics: a real discount, recorded per pass
+    assert float(comp.rows_contracted) < 0.75 * float(base.rows_contracted)
+    pr = np.asarray(comp.pass_rows)[:passes]
+    assert pr[0] == n                       # root pass is always full
+    compacted = pr[pr <= n // 4]
+    assert len(compacted) >= 10             # late tree mostly compacts
+    # the end-of-tree tail contracts a strictly decreasing row count,
+    # totalling under N/2 where the old path would report ~7 full N
+    tail = pr[-5:]
+    assert np.all(np.diff(tail) < 0)
+    assert pr[-7:].sum() < n // 2
+    assert pr[-1] < n // 16
+
+
 def test_subtraction_respects_padding_suffix():
     """Padding rows (beyond n_valid) contribute nothing; real-row trees
     must be unchanged under subtraction + padding."""

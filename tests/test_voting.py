@@ -149,11 +149,12 @@ def test_voting_psum_operand_is_elected_slice(problem):
     state_spec = TreeGrowerState(
         **{name: (P("data") if name == "leaf_id" else P())
            for name in TreeGrowerState._fields})
-    sharded = jax.shard_map(
+    from lightgbm_tpu.parallel.learners import shard_map_compat
+    sharded = shard_map_compat(
         run, mesh=mesh,
         in_specs=(P("data", None), P("data"), P("data"), P("data"), P(None))
                  + (P(None),) * 7,
-        out_specs=state_spec, check_vma=False)
+        out_specs=state_spec)
     jaxpr = jax.make_jaxpr(sharded)(
         jnp.asarray(ds.binned), jnp.asarray(grad), jnp.asarray(hess),
         jnp.ones(n, jnp.float32), jnp.ones(ds.num_features, bool),
