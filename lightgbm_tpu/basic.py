@@ -586,6 +586,39 @@ class Booster:
                                    pred_early_stop_margin=pred_early_stop_margin)
 
     # ------------------------------------------------------------------
+    # checkpoint/resume (lightgbm_tpu/checkpoint.py): the payload wraps
+    # the engine state with the model string so any snapshot doubles as
+    # a loadable model file source
+    def checkpoint_state(self) -> dict:
+        from . import checkpoint as ckpt_mod
+        inner_state = self._inner.checkpoint_state()
+        return {
+            "format": ckpt_mod.FORMAT_VERSION,
+            "iteration": int(inner_state["iter"]),
+            "boosting_type": self.config.boosting_type,
+            "model": self._inner.save_model_to_string(),
+            "state": inner_state,
+            "booster": {
+                "best_iteration": int(self.best_iteration),
+                "best_score": {d: dict(m)
+                               for d, m in self.best_score.items()},
+            },
+        }
+
+    def restore_state(self, payload: dict) -> "Booster":
+        """Apply a snapshot payload to this (freshly constructed, same
+        config/data) booster. Engine-level concerns — fingerprint check,
+        callback state — live in `lightgbm_tpu.engine`."""
+        import collections as _collections
+        self._inner.restore_state(payload["state"], payload["model"])
+        meta = payload.get("booster", {})
+        self.best_iteration = int(meta.get("best_iteration", -1))
+        self.best_score = {
+            d: _collections.OrderedDict(m)
+            for d, m in meta.get("best_score", {}).items()}
+        return self
+
+    # ------------------------------------------------------------------
     def save_model(self, filename: str, num_iteration: int = -1) -> "Booster":
         self._inner.save_model(filename, num_iteration)
         return self

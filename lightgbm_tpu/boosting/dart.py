@@ -13,6 +13,7 @@ from typing import List
 
 import numpy as np
 
+from .. import checkpoint as ckpt
 from .gbdt import GBDT
 from ..ops.predict import predict_value_binned
 
@@ -31,6 +32,58 @@ class DART(GBDT):
 
     def model_name(self) -> str:
         return "dart"
+
+    # ------------------------------------------------------------------
+    # DART owns mutable cross-iteration state the base class doesn't:
+    # the per-tree weight ledger (future drop probabilities are weight-
+    # proportional) and the host drop RNG. Both must survive checkpoint/
+    # resume and model-text round-trips or a restarted run diverges.
+    def _checkpoint_extra(self) -> dict:
+        return {
+            "tree_weight": [float(w) for w in self.tree_weight],
+            "sum_weight": float(self.sum_weight),
+            "drop_rng": ckpt.encode_rng(self._drop_rng),
+        }
+
+    def _restore_extra(self, extra: dict) -> None:
+        self.tree_weight = [float(w) for w in extra.get("tree_weight", [])]
+        self.sum_weight = float(extra.get("sum_weight", 0.0))
+        if "drop_rng" in extra:
+            self._drop_rng = ckpt.decode_rng(extra["drop_rng"])
+        self.drop_index = []
+
+    def _extra_model_header(self, num_iteration: int = -1):
+        # the drop ledger rides in the model text too (reference DART
+        # cannot continue-train a loaded model for exactly this reason —
+        # dart.hpp keeps the ledger in memory only); repr() round-trips
+        # the doubles exactly. Truncated saves truncate the ledger.
+        weights = self.tree_weight
+        sum_weight = self.sum_weight
+        if 0 < num_iteration < len(weights):
+            weights = weights[:num_iteration]
+            sum_weight = float(sum(weights))
+        if not weights:
+            return []
+        # full saves emit the exact RUNNING sum (maintained incrementally
+        # through _normalize; recomputing would change the f64 rounding)
+        return ["tpu_dart_tree_weights=" + " ".join(
+                    repr(float(w)) for w in weights),
+                "tpu_dart_sum_weight=" + repr(float(sum_weight))]
+
+    def load_model_from_string(self, text: str) -> None:
+        super().load_model_from_string(text)
+        self.tree_weight = []
+        self.sum_weight = 0.0
+        self.drop_index = []
+        for line in text.splitlines():
+            ls = line.strip()
+            if ls.startswith("tpu_dart_tree_weights="):
+                self.tree_weight = [float(w)
+                                    for w in ls.split("=", 1)[1].split()]
+            elif ls.startswith("tpu_dart_sum_weight="):
+                self.sum_weight = float(ls.split("=", 1)[1])
+            elif ls.startswith("Tree="):
+                break
 
     def _tree_contribution(self, it: int, sign: float, on_valid: bool):
         """Add sign * tree(it) to train (and optionally valid) scores."""

@@ -15,7 +15,9 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from .. import checkpoint as ckpt
 from .. import log
+from ..testing import faults
 from ..config import Config
 from ..dataset import Dataset, Metadata
 from ..learner.grow import GrowerConfig, grow_tree
@@ -196,6 +198,22 @@ def _bagging_mask_impl(ridx, *, seed, n, n_pad, fraction):
 
 
 _bagging_mask_jit = None
+
+
+_nonfinite_probe_jit = None
+
+
+def _nonfinite_probe_device(grad, hess):
+    """Device bool scalar: any non-finite gradient/hessian. Returned
+    UNFETCHED so the pipelined path can overlap the reduction with tree
+    growth and read it at the next flush instead of syncing here."""
+    import jax
+    import jax.numpy as jnp
+    global _nonfinite_probe_jit
+    if _nonfinite_probe_jit is None:
+        _nonfinite_probe_jit = jax.jit(
+            lambda g, h: ~(jnp.isfinite(g).all() & jnp.isfinite(h).all()))
+    return _nonfinite_probe_jit(grad, hess)
 
 
 def _bagging_mask_device(seed: int, refresh_idx, n: int, n_pad: int,
@@ -691,6 +709,9 @@ class GBDT:
         """One boosting iteration (reference: GBDT::TrainOneIter,
         gbdt.cpp:380-474). Returns True when no further splits are possible
         (training should stop)."""
+        # injection point: a dying TPU worker surfaces as a failed grow
+        # dispatch (testing/faults.py)
+        faults.inject("backend.grow")
         import jax.numpy as jnp
 
         from .. import tracing
@@ -714,6 +735,7 @@ class GBDT:
             hess = hess.reshape(-1)
         grad = grad.reshape(k, n_pad)
         hess = hess.reshape(k, n_pad)
+        probe = self._nonfinite_probe(grad, hess)
 
         with tracing.phase("boosting/bagging"):
             bag = self._bagging_weights(self.iter_, grad, hess)
@@ -724,6 +746,7 @@ class GBDT:
         from ..learner.grow import FMETA_KEYS
 
         if k > 1 and self._dist_grower is None:
+            self._raise_if_nonfinite(probe, self.iter_)
             return self._train_one_iter_multi(grad, hess, row_weight)
 
         import os
@@ -731,7 +754,9 @@ class GBDT:
                 and gradients is None
                 and getattr(self, "_supports_pipeline", True)
                 and not os.environ.get("LGBM_TPU_NO_PIPELINE")):
-            return self._train_one_iter_pipelined(grad, hess, row_weight)
+            return self._train_one_iter_pipelined(grad, hess, row_weight,
+                                                  probe)
+        self._raise_if_nonfinite(probe, self.iter_)
 
         # leaving the pipelined path (explicit gradients, a valid set
         # added mid-training, ...): drain the pending tree FIRST so
@@ -796,7 +821,8 @@ class GBDT:
 
         return self._finish_iter(could_split_any)
 
-    def _train_one_iter_pipelined(self, grad, hess, row_weight) -> bool:
+    def _train_one_iter_pipelined(self, grad, hess, row_weight,
+                                  probe=None) -> bool:
         """Serial-learner iteration with the tree fetch pipelined one
         iteration behind the device dispatch (see __init__ note). The
         stop/rollback decision therefore lags one iteration: a
@@ -826,10 +852,11 @@ class GBDT:
                 self._grower_cfg)
         # fetch + build the PREVIOUS tree while this one runs on device
         ok_prev = self._flush_pending()
-        # stash the DISPATCH-TIME shrinkage: a learning-rate schedule
-        # (reset_parameter callback) changes self.shrinkage_rate before
-        # the flush happens one iteration later
-        self._pending_small = (small, self.shrinkage_rate)
+        # stash the DISPATCH-TIME shrinkage (a learning-rate schedule
+        # changes self.shrinkage_rate before the flush happens one
+        # iteration later) and the dispatch-time non-finite probe and
+        # iteration index, fetched together with the small tree arrays
+        self._pending_small = (small, self.shrinkage_rate, probe, self.iter_)
         self.iter_ += 1
         if not ok_prev:
             # previous iteration produced no split: unwind the
@@ -839,8 +866,9 @@ class GBDT:
             # score, so roll it back the way rollback_one_iter does —
             # materialize and subtract its traversal values — instead of
             # assuming the delta was zero.
-            small, shrink = self._pending_small
+            small, shrink, probe, it = self._pending_small
             self._pending_small = None
+            self._raise_if_nonfinite(probe, it)
             self.iter_ -= 1
             tree = self._materialize_small(small, shrink, fold_bias=False)
             if tree.num_leaves > 1:
@@ -892,8 +920,9 @@ class GBDT:
         tree could not split (its iteration is rolled back here)."""
         if self._pending_small is None:
             return True
-        small, shrink = self._pending_small
+        small, shrink, probe, it = self._pending_small
         self._pending_small = None
+        self._raise_if_nonfinite(probe, it)
         tree = self._materialize_small(small, shrink)
         if tree.num_leaves > 1:
             self.models.append(tree)
@@ -916,6 +945,31 @@ class GBDT:
         """Drain the async pipeline (engine.train calls this after the
         boosting loop; model/prediction readers call it defensively)."""
         self._flush_pending()
+
+    # ------------------------------------------------------------------
+    # NaN/Inf gradient guard
+    def _nonfinite_probe(self, grad, hess):
+        """Lazily-fetched device flag; None when the guard is disabled
+        (tpu_guard_nonfinite=false)."""
+        if not self.config.boosting.tpu_guard_nonfinite:
+            return None
+        return _nonfinite_probe_device(grad, hess)
+
+    def _raise_if_nonfinite(self, probe, iteration: int) -> None:
+        """A NaN/Inf gradient would not crash anything downstream — the
+        histogram sums just absorb it and every later tree fits garbage
+        residuals — so fail loudly, naming the objective and iteration,
+        instead of silently degrading the whole remaining run."""
+        if probe is None or not bool(probe):
+            return
+        name = self.objective.name if self.objective is not None \
+            else "custom (fobj)"
+        raise log.LightGBMError(
+            "Objective '%s' produced non-finite gradients/hessians at "
+            "iteration %d. This usually means the labels/init_score "
+            "contain NaN/Inf, the learning rate diverged the scores, or "
+            "a custom objective overflowed; set tpu_guard_nonfinite="
+            "false to disable this check." % (name, iteration))
 
     def _update_valid_scores(self, cls: int, tree) -> None:
         from .. import tracing
@@ -1216,6 +1270,7 @@ class GBDT:
             # only reachable for models loaded from old-format files; new
             # models carry the bias inside the first tree (AddBias)
             out.append(f"init_score_bias={self.init_score_bias}")
+        out.extend(self._extra_model_header(num_iteration))
         out.append("")
         total = len(self.models)
         if num_iteration > 0:
@@ -1233,9 +1288,17 @@ class GBDT:
             out.append(f"{name}={int(v)}")
         return "\n".join(out) + "\n"
 
+    def _extra_model_header(self, num_iteration: int = -1) -> List[str]:
+        """Subclass hook for extra `key=value` header lines (DART's drop
+        ledger); emitted before the tree blocks, ignored by loaders that
+        don't know them."""
+        return []
+
     def save_model(self, filename: str, num_iteration: int = -1) -> None:
-        with open(filename, "w") as fh:
-            fh.write(self.save_model_to_string(num_iteration))
+        # atomic (tmp + fsync + rename): a preemption mid-save must never
+        # leave a truncated file that still parses as a shorter model
+        ckpt.atomic_write_text(filename,
+                               self.save_model_to_string(num_iteration))
         log.info("Saved model to %s", filename)
 
     def load_model_from_string(self, text: str) -> None:
@@ -1275,6 +1338,97 @@ class GBDT:
         self.average_output = "average_output" in kv
         self.models = [Tree.from_string("\n".join(b)) for b in tree_blocks]
         self.iter_ = len(self.models) // max(self.num_tree_per_iteration, 1)
+
+    # ------------------------------------------------------------------
+    # checkpoint/resume (lightgbm_tpu/checkpoint.py drives this through
+    # engine.train; the contract is bit-identical restart: everything the
+    # next train_one_iter reads must round-trip EXACTLY)
+    def _checkpoint_extra(self) -> dict:
+        """Subclass hook for boosting-variant state (DART's drop ledger +
+        drop RNG). GOSS and bagging need nothing here: their row masks
+        are pure functions of (seed, iteration) via jax fold_in."""
+        return {}
+
+    def _restore_extra(self, extra: dict) -> None:
+        return None
+
+    def checkpoint_state(self) -> dict:
+        """Full JSON-serializable training state EXCLUDING the model
+        string (the snapshot payload carries that separately so tooling
+        can extract a plain model from any checkpoint). Scores are the
+        exact f32 device arrays: replaying trees would re-sum their
+        contributions in a different order and break bit-identity."""
+        self.finalize_training()
+        state = {
+            "iter": int(self.iter_),
+            "shrinkage_rate": float(self.shrinkage_rate),
+            "init_score_bias": float(self.init_score_bias),
+            "pending_bias": float(getattr(self, "_pending_bias", 0.0)),
+            "stopped": bool(self._stopped),
+            "score": ckpt.encode_array(np.asarray(self._score)),
+            "valid_scores": [ckpt.encode_array(np.asarray(v))
+                             for v in getattr(self, "_valid_score", [])],
+            "feature_rng": ckpt.encode_rng(self._feature_rng),
+            "best_iter": {k: int(v) for k, v in self.best_iter.items()},
+            "best_score": {k: dict(v) for k, v in self.best_score.items()},
+            "eval_history": list(self._eval_history),
+            "extra": self._checkpoint_extra(),
+        }
+        return state
+
+    def restore_state(self, state: dict, model_str: str) -> None:
+        """Inverse of checkpoint_state, applied to a freshly-init()'d
+        booster (same dataset, same config — the engine verifies the
+        config fingerprint before calling this)."""
+        import jax.numpy as jnp
+        self.finalize_training()
+        self.load_model_from_string(model_str)
+        for tree in self.models:
+            # our text carries complete bin/group metadata, so loaded
+            # trees are device-ready as-is; only legacy/reference text
+            # needs re-derivation (which is NOT bit-exactness-critical:
+            # such models never came from a checkpoint of this build)
+            if tree.num_leaves > 1 and not tree.has_bin_metadata:
+                tree.attach_bin_metadata(self.train_data)
+        self.iter_ = int(state["iter"])
+        self.shrinkage_rate = float(state["shrinkage_rate"])
+        self.init_score_bias = float(state["init_score_bias"])
+        self._pending_bias = float(state["pending_bias"])
+        self._stopped = bool(state["stopped"])
+        score = ckpt.decode_array(state["score"])
+        if tuple(score.shape) != tuple(np.asarray(self._score).shape):
+            raise log.LightGBMError(
+                "Checkpoint score shape %s does not match this training "
+                "setup %s — the dataset differs from the checkpointed "
+                "run" % (score.shape, np.asarray(self._score).shape))
+        self._score = jnp.asarray(score)
+        valid_encs = state.get("valid_scores", [])
+        have = getattr(self, "_valid_score", [])
+        if len(valid_encs) != len(have):
+            raise log.LightGBMError(
+                "Checkpoint carries %d validation-score arrays but %d "
+                "validation sets are attached; resume with the same "
+                "valid_sets as the original run"
+                % (len(valid_encs), len(have)))
+        for vi, enc in enumerate(valid_encs):
+            vs = ckpt.decode_array(enc)
+            if tuple(vs.shape) != tuple(np.asarray(have[vi]).shape):
+                raise log.LightGBMError(
+                    "Checkpoint valid set %d score shape %s != %s — "
+                    "validation data differs from the checkpointed run"
+                    % (vi, vs.shape, np.asarray(have[vi]).shape))
+            self._valid_score[vi] = jnp.asarray(vs)
+        self._feature_rng = ckpt.decode_rng(state["feature_rng"])
+        self.best_iter = {k: int(v)
+                          for k, v in state.get("best_iter", {}).items()}
+        self.best_score = {k: dict(v)
+                           for k, v in state.get("best_score", {}).items()}
+        self._eval_history = list(state.get("eval_history", []))
+        # derived per-iteration caches must not leak across the restore
+        self._pending_small = None
+        if hasattr(self, "_bag_cache"):
+            del self._bag_cache
+        self._restore_extra(state.get("extra", {}))
 
     # ------------------------------------------------------------------
     def feature_importance(self, importance_type: str = "split",

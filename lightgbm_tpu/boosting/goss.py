@@ -25,6 +25,15 @@ class GOSS(GBDT):
     def model_name(self) -> str:
         return "goss"
 
+    def _checkpoint_extra(self) -> dict:
+        """GOSS needs NO extra checkpoint state: its subsample RNG is
+        stateless — the row weights are a pure function of
+        (bagging_seed, iteration) via jax.random.fold_in, and the top-k
+        threshold derives from the (restored) score's gradients. Resume
+        at iteration k therefore reproduces the exact masks of the
+        uninterrupted run (asserted in tests/test_checkpoint.py)."""
+        return {}
+
     def _bagging_weights(self, iter_idx, grad=None, hess=None):
         """GOSS row weights built ON DEVICE (no per-iteration [N]
         argsort on host / H2D upload): the top_rate threshold comes from

@@ -45,10 +45,21 @@ class RF(GBDT):
         k = self.num_tree_per_iteration
         zero = jnp.zeros((k, self._n_pad), jnp.float32)
         g, h = self.objective.get_gradients(zero.reshape(-1))
+        # RF never recomputes gradients, so a NaN label would poison
+        # EVERY tree — check the one batch that matters up front
+        self._raise_if_nonfinite(self._nonfinite_probe(g, h), 0)
         self._rf_grad = g
         self._rf_hess = h
 
+    def _checkpoint_extra(self) -> dict:
+        """RF needs no extra checkpoint state: `_rf_grad`/`_rf_hess` are
+        rebuilt bit-identically by init() (gradients of the zero score),
+        and its bagging masks are stateless like the base class's."""
+        return {}
+
     def train_one_iter(self, gradients=None, hessians=None) -> bool:
+        from ..testing import faults
+        faults.inject("backend.grow")
         import jax.numpy as jnp
         k = self.num_tree_per_iteration
         n_pad = self._n_pad

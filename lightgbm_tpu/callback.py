@@ -64,7 +64,24 @@ def record_evaluation(eval_result: dict) -> Callable:
             eval_result.setdefault(data_name, collections.OrderedDict())
             eval_result[data_name].setdefault(eval_name, [])
             eval_result[data_name][eval_name].append(result)
+
+    # checkpoint/resume protocol: a resumed run must re-enter the loop
+    # with the recorded history of the interrupted one, or the user's
+    # evals_result dict restarts mid-run with a hole in every series
+    def _state() -> dict:
+        return {d: {m: list(v) for m, v in metrics.items()}
+                for d, metrics in eval_result.items()}
+
+    def _restore(state: dict) -> None:
+        eval_result.clear()
+        for d, metrics in state.items():
+            eval_result[d] = collections.OrderedDict(
+                (m, [float(x) for x in v]) for m, v in metrics.items())
+
     _callback.order = 20
+    _callback.checkpoint_key = "record_evaluation"
+    _callback.checkpoint_state = _state
+    _callback.restore_state = _restore
     return _callback
 
 
@@ -89,12 +106,44 @@ def reset_parameter(**kwargs) -> Callable:
     return _callback
 
 
+def checkpoint(save_fn: Callable, interval: int = 1) -> Callable:
+    """Periodic full-state snapshot (preemption tolerance). `save_fn(env)`
+    builds and writes the snapshot — `lightgbm_tpu.engine` wires it to a
+    `checkpoint.CheckpointManager`. Runs AFTER early_stopping (order 40)
+    so a restored snapshot carries the patience state of its own
+    iteration, not the previous one.
+
+    A failed WRITE is logged and training continues: losing one snapshot
+    (the previous one still restores) is strictly better than killing a
+    long run over a transient filesystem error. Only IO-shaped errors
+    are swallowed — anything else (e.g. the non-finite-gradient guard
+    firing inside the state capture's pipeline flush) is a training
+    error and must propagate."""
+    def _callback(env: CallbackEnv) -> None:
+        if interval > 0 and (env.iteration + 1) % interval == 0:
+            from .checkpoint import CheckpointError
+            from .testing.faults import InjectedFault
+            try:
+                save_fn(env)
+            except (OSError, CheckpointError, InjectedFault) as exc:
+                # deliberately NOT RuntimeError: jax backend failures
+                # (XlaRuntimeError) during the state capture's pipeline
+                # flush mean the training state itself is suspect
+                from . import log
+                log.warning("Checkpoint write failed at iteration %d "
+                            "(%s: %s); continuing without it",
+                            env.iteration + 1, type(exc).__name__, exc)
+    _callback.order = 40
+    return _callback
+
+
 def early_stopping(stopping_rounds: int, verbose: bool = True) -> Callable:
     """Reference: callback.py early_stopping."""
     best_score: List[float] = []
     best_iter: List[int] = []
     best_score_list: List = []
     cmp_op: List[Callable] = []
+    higher_better: List[bool] = []
 
     def _init(env: CallbackEnv) -> None:
         if not env.evaluation_result_list:
@@ -105,6 +154,7 @@ def early_stopping(stopping_rounds: int, verbose: bool = True) -> Callable:
             best_score_list.append(None)
 
         for _, _, _, is_higher_better in env.evaluation_result_list:
+            higher_better.append(bool(is_higher_better))
             if is_higher_better:
                 best_score.append(float("-inf"))
                 cmp_op.append(lambda a, b: a > b)
@@ -126,5 +176,34 @@ def early_stopping(stopping_rounds: int, verbose: bool = True) -> Callable:
                     log.info("Early stopping, best iteration is: [%d]",
                              best_iter[i] + 1)
                 raise EarlyStopException(best_iter[i], best_score_list[i])
+
+    # checkpoint/resume protocol: without the best-score history a
+    # resumed run would reset its patience counter and stop late (or,
+    # with a restarted best_score baseline, stop on the wrong iteration)
+    def _state() -> dict:
+        return {
+            "best_score": list(best_score),
+            "best_iter": [int(x) for x in best_iter],
+            "best_score_list": [
+                None if lst is None else [[d, m, float(v), bool(b)]
+                                          for d, m, v, b in lst]
+                for lst in best_score_list],
+            "higher_better": list(higher_better),
+        }
+
+    def _restore(state: dict) -> None:
+        best_score[:] = [float(x) for x in state["best_score"]]
+        best_iter[:] = [int(x) for x in state["best_iter"]]
+        best_score_list[:] = [
+            None if lst is None else [(d, m, float(v), bool(b))
+                                      for d, m, v, b in lst]
+            for lst in state["best_score_list"]]
+        higher_better[:] = [bool(x) for x in state["higher_better"]]
+        cmp_op[:] = [(lambda a, b: a > b) if hb else (lambda a, b: a < b)
+                     for hb in higher_better]
+
     _callback.order = 30
+    _callback.checkpoint_key = "early_stopping"
+    _callback.checkpoint_state = _state
+    _callback.restore_state = _restore
     return _callback

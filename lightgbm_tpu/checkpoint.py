@@ -1,0 +1,284 @@
+"""Crash-consistent checkpoint/resume for preemptible training.
+
+TPU pods are preemptible; a 50k-iteration boosting run must survive its
+host dying between any two iterations. This module provides:
+
+- `atomic_write_bytes` / `atomic_write_text` — tmp file in the target
+  directory + flush + fsync + atomic rename (+ directory fsync), so a
+  reader never observes a partially-written file. `GBDT.save_model` and
+  the snapshot store both write through it.
+- `CheckpointManager` — a keep-last-K rotation of versioned full-state
+  snapshots, one file per (iteration, process rank). Every snapshot
+  carries a self-describing header with a SHA-256 of the payload;
+  `load_latest` validates it and silently falls back past corrupt or
+  truncated snapshots to the newest good one.
+- `config_fingerprint` — a digest of every training-trajectory-relevant
+  parameter plus the dataset shape. Resume refuses a snapshot whose
+  fingerprint differs, because restoring RNG/score state into a run with
+  different semantics would produce a model that is neither the old nor
+  the new configuration's.
+- array/RNG codecs used by `GBDT.checkpoint_state()` to serialize the
+  exact f32 score arrays and numpy RNG states, which is what makes a
+  resumed run *bit-identical* to an uninterrupted one (the deterministic
+  JAX core does the rest: bagging/GOSS masks are pure functions of
+  (seed, iteration)).
+
+Snapshot file layout (`ckpt_00000023.r0`):
+
+    LGBMTPU-CKPT/1 sha256=<hex> bytes=<payload-len>\\n
+    <canonical-JSON payload>
+
+The payload holds the model string, the boosting state dict, callback
+states (early stopping / recorded evaluations) and the fingerprint.
+"""
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import os
+import re
+import tempfile
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from . import log
+from .testing import faults
+
+FORMAT_VERSION = 1
+_HEADER_RE = re.compile(
+    rb"^LGBMTPU-CKPT/(\d+) sha256=([0-9a-f]{64}) bytes=(\d+)\n")
+
+# params that do not change the training trajectory (or are expected to
+# legitimately differ between the original and the resumed invocation)
+_FINGERPRINT_EXCLUDE = {
+    "tpu_checkpoint_dir", "tpu_checkpoint_interval", "tpu_checkpoint_keep",
+    "output_model", "output_result", "input_model", "convert_model",
+    "config_file", "machine_list_file", "snapshot_freq", "verbose",
+    "metric_freq", "num_iterations", "num_threads", "task",
+}
+
+
+class CheckpointError(log.LightGBMError):
+    """A snapshot failed validation (corrupt, truncated, wrong version)."""
+
+
+# ---------------------------------------------------------------------------
+# atomic file IO
+# ---------------------------------------------------------------------------
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    """Write `data` to `path` crash-consistently: a same-directory tmp
+    file is written and fsync'd, then atomically renamed over the target
+    (so an interrupt leaves either the old file or the new one, never a
+    truncated hybrid), then the directory entry is fsync'd."""
+    directory = os.path.dirname(os.path.abspath(path))
+    faults.inject("checkpoint.write")
+    fd, tmp_path = tempfile.mkstemp(
+        dir=directory, prefix=os.path.basename(path) + ".tmp.")
+    try:
+        with os.fdopen(fd, "wb") as fh:
+            fh.write(data)
+            fh.flush()
+            os.fsync(fh.fileno())
+        faults.inject("checkpoint.rename")
+        os.replace(tmp_path, path)
+    except BaseException:
+        try:
+            os.unlink(tmp_path)
+        except OSError:
+            pass
+        raise
+    # persist the rename itself (POSIX: directory fsync); best-effort on
+    # filesystems that refuse O_RDONLY directory fds
+    try:
+        dfd = os.open(directory, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:  # pragma: no cover
+        pass
+
+
+def atomic_write_text(path: str, text: str) -> None:
+    atomic_write_bytes(path, text.encode("utf-8"))
+
+
+# ---------------------------------------------------------------------------
+# codecs (JSON-safe encodings of numpy arrays and RNG states)
+# ---------------------------------------------------------------------------
+def encode_array(arr: np.ndarray) -> Dict[str, Any]:
+    arr = np.ascontiguousarray(arr)
+    return {"dtype": str(arr.dtype), "shape": list(arr.shape),
+            "b64": base64.b64encode(arr.tobytes()).decode("ascii")}
+
+
+def decode_array(enc: Dict[str, Any]) -> np.ndarray:
+    raw = base64.b64decode(enc["b64"])
+    return np.frombuffer(raw, dtype=np.dtype(enc["dtype"])).reshape(
+        enc["shape"]).copy()
+
+
+def encode_rng(rng: np.random.RandomState) -> Dict[str, Any]:
+    """Serialize the exact Mersenne-Twister position so feature-fraction
+    and DART drop sampling continue the original sequence on resume."""
+    alg, keys, pos, has_gauss, cached = rng.get_state()
+    return {"alg": alg, "keys": encode_array(np.asarray(keys)),
+            "pos": int(pos), "has_gauss": int(has_gauss),
+            "cached": float(cached)}
+
+
+def decode_rng(enc: Dict[str, Any]) -> np.random.RandomState:
+    rng = np.random.RandomState()
+    rng.set_state((enc["alg"], decode_array(enc["keys"]).astype(np.uint32),
+                   int(enc["pos"]), int(enc["has_gauss"]),
+                   float(enc["cached"])))
+    return rng
+
+
+# ---------------------------------------------------------------------------
+# config fingerprint
+# ---------------------------------------------------------------------------
+def config_fingerprint(raw_params: Dict[str, Any], num_data: int,
+                       num_features: int, boosting_type: str) -> str:
+    """Digest of the training trajectory's inputs. Two runs with the same
+    fingerprint and the same data bytes walk identical iteration
+    sequences, so a snapshot from one may seed the other."""
+    items = sorted((str(k), str(v)) for k, v in raw_params.items()
+                   if str(k) not in _FINGERPRINT_EXCLUDE)
+    blob = json.dumps({"params": items, "rows": int(num_data),
+                       "features": int(num_features),
+                       "boosting": boosting_type,
+                       "format": FORMAT_VERSION},
+                      sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode("utf-8")).hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# snapshot store
+# ---------------------------------------------------------------------------
+class CheckpointManager:
+    """Keep-last-K rotation of checksummed snapshots in one directory.
+
+    Files are `ckpt_<iteration:08d>.r<rank>`; under multi-host training
+    every process writes its own rank file (scores are row-shard-local)
+    and resumes from its own series — `lightgbm_tpu.engine` aligns the
+    resume iteration across ranks."""
+
+    _NAME_RE = re.compile(r"^ckpt_(\d{8})\.r(\d+)$")
+
+    def __init__(self, directory: str, keep_last: int = 3,
+                 rank: Optional[int] = None):
+        self.directory = directory
+        self.keep_last = max(1, int(keep_last))
+        if rank is None:
+            try:
+                import jax
+                rank = jax.process_index()
+            except Exception:  # backend not initialized yet
+                rank = 0
+        self.rank = int(rank)
+        os.makedirs(directory, exist_ok=True)
+        self._sweep_stale_tmp()
+
+    def _sweep_stale_tmp(self) -> None:
+        """A REAL preemption between mkstemp and rename orphans a tmp
+        file; nothing would ever reclaim it (the in-process cleanup only
+        runs if the process survives), so each repeatedly-preempted run
+        would leak one per kill. Sweep this rank's leftovers at startup
+        — the single writer per rank makes any existing tmp stale by
+        definition."""
+        marker = f".r{self.rank}.tmp."
+        for name in os.listdir(self.directory):
+            if self._NAME_RE.match(name) is None and marker in name \
+                    and name.startswith("ckpt_"):
+                try:
+                    os.unlink(os.path.join(self.directory, name))
+                except OSError:  # pragma: no cover
+                    pass
+
+    # -- paths ----------------------------------------------------------
+    def path_for(self, iteration: int) -> str:
+        return os.path.join(self.directory,
+                            f"ckpt_{int(iteration):08d}.r{self.rank}")
+
+    def snapshots(self) -> List[Tuple[int, str]]:
+        """(iteration, path) pairs for this rank, oldest first."""
+        out = []
+        for name in os.listdir(self.directory):
+            m = self._NAME_RE.match(name)
+            if m and int(m.group(2)) == self.rank:
+                out.append((int(m.group(1)),
+                            os.path.join(self.directory, name)))
+        return sorted(out)
+
+    def available_iterations(self) -> List[int]:
+        return [it for it, _ in self.snapshots()]
+
+    # -- write ----------------------------------------------------------
+    def save(self, payload: Dict[str, Any], iteration: int) -> str:
+        data = json.dumps(payload, sort_keys=True,
+                          separators=(",", ":")).encode("utf-8")
+        header = (f"LGBMTPU-CKPT/{FORMAT_VERSION} "
+                  f"sha256={hashlib.sha256(data).hexdigest()} "
+                  f"bytes={len(data)}\n").encode("ascii")
+        path = self.path_for(iteration)
+        atomic_write_bytes(path, header + data)
+        self._rotate()
+        return path
+
+    def _rotate(self) -> None:
+        snaps = self.snapshots()
+        for _, path in snaps[:-self.keep_last]:
+            try:
+                os.unlink(path)
+            except OSError as exc:  # pragma: no cover
+                log.warning("Could not remove old checkpoint %s: %s",
+                            path, exc)
+
+    # -- read -----------------------------------------------------------
+    def load(self, path: str) -> Dict[str, Any]:
+        """Parse + validate one snapshot; raises CheckpointError on any
+        corruption (bad header, truncation, checksum or JSON failure)."""
+        faults.inject("checkpoint.read")
+        with open(path, "rb") as fh:
+            blob = fh.read()
+        m = _HEADER_RE.match(blob)
+        if not m:
+            raise CheckpointError(f"{path}: missing/garbled header")
+        version, digest, nbytes = (int(m.group(1)), m.group(2).decode(),
+                                   int(m.group(3)))
+        if version > FORMAT_VERSION:
+            raise CheckpointError(
+                f"{path}: format version {version} is newer than this "
+                f"build supports ({FORMAT_VERSION})")
+        payload = blob[m.end():]
+        if len(payload) != nbytes:
+            raise CheckpointError(
+                f"{path}: truncated ({len(payload)} of {nbytes} payload "
+                "bytes)")
+        if hashlib.sha256(payload).hexdigest() != digest:
+            raise CheckpointError(f"{path}: payload checksum mismatch")
+        try:
+            return json.loads(payload.decode("utf-8"))
+        except (UnicodeDecodeError, json.JSONDecodeError) as exc:
+            raise CheckpointError(f"{path}: payload not parseable "
+                                  f"({exc})") from exc
+
+    def load_iteration(self, iteration: int) -> Dict[str, Any]:
+        return self.load(self.path_for(iteration))
+
+    def load_latest(self) -> Optional[Tuple[Dict[str, Any], str]]:
+        """Newest snapshot that validates; corrupt ones are skipped with
+        a warning (crash-mid-write leaves either no file or, with a
+        non-atomic filesystem, a file this rejects — the previous
+        snapshot then restores a slightly older but consistent state)."""
+        for iteration, path in reversed(self.snapshots()):
+            try:
+                return self.load(path), path
+            except (CheckpointError, OSError) as exc:
+                log.warning("Skipping unusable checkpoint %s (%s); "
+                            "falling back to the previous snapshot",
+                            path, exc)
+        return None

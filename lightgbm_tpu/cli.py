@@ -182,6 +182,16 @@ def run_train(params: Dict, cfg: Config) -> None:
         valid_sets.append(_build_dataset(vpath, params, cfg, reference=train_set))
         valid_names.append(os.path.basename(vpath))
 
+    if cfg.io.tpu_checkpoint_dir:
+        # engine.train resumes from / writes to this directory; surfaced
+        # here so operators see preemption tolerance is armed before the
+        # (possibly hours-long) run starts
+        log.info("Preemption-tolerant training: full-state checkpoint "
+                 "every %d iteration(s) to %s (keep last %d); rerun this "
+                 "exact command after a preemption to resume "
+                 "bit-identically", max(1, cfg.io.tpu_checkpoint_interval),
+                 cfg.io.tpu_checkpoint_dir, cfg.io.tpu_checkpoint_keep)
+
     callbacks = []
     if cfg.io.snapshot_freq > 0:
         # periodic model snapshots (reference: GBDT::Train, gbdt.cpp:349-353

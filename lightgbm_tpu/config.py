@@ -133,6 +133,16 @@ class IOConfig:
     data_filename: str = ""
     valid_data_filenames: List[str] = field(default_factory=list)
     snapshot_freq: int = -1
+    # preemption-tolerant training (lightgbm_tpu/checkpoint.py): when a
+    # directory is set, engine.train writes a crash-consistent full-state
+    # snapshot (model + RNG states + DART ledger + scores + early-stop
+    # history) every tpu_checkpoint_interval iterations and resumes
+    # BIT-IDENTICALLY from the newest valid one on restart. Each
+    # snapshot drains the async tree pipeline and fetches the score
+    # arrays off device, so very small intervals tax the hot loop
+    tpu_checkpoint_dir: str = ""
+    tpu_checkpoint_interval: int = 10
+    tpu_checkpoint_keep: int = 3
     is_predict_raw_score: bool = False
     is_predict_leaf_index: bool = False
     is_predict_contrib: bool = False
@@ -258,6 +268,10 @@ class BoostingConfig:
     # GOSS
     top_rate: float = 0.2
     other_rate: float = 0.1
+    # raise a descriptive error when an objective emits NaN/Inf
+    # gradients/hessians or a metric evaluates non-finite, instead of
+    # silently growing garbage trees for the rest of the run
+    tpu_guard_nonfinite: bool = True
 
 
 _BOOL_TRUE = {"true", "1", "yes", "y", "t", "+"}

@@ -9,14 +9,17 @@ from __future__ import annotations
 
 import collections
 import copy
+import math
 from typing import Any, Dict, List, Optional
 
 import numpy as np
 
 from . import callback as callback_mod
+from . import checkpoint as checkpoint_mod
 from . import log
 from .basic import Booster, Dataset, LightGBMError
 from .config import key_alias_transform
+from .testing import faults
 
 
 def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
@@ -82,6 +85,11 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
         callbacks.append(callback_mod.reset_parameter(learning_rate=learning_rates))
     if evals_result is not None:
         callbacks.append(callback_mod.record_evaluation(evals_result))
+    # preemption-tolerant checkpointing (lightgbm_tpu/checkpoint.py):
+    # resume from the newest valid snapshot, then snapshot every
+    # tpu_checkpoint_interval iterations through the checkpoint callback
+    start_iter = _setup_checkpointing(booster, callbacks)
+
     callbacks_before = [cb for cb in callbacks if getattr(cb, "before_iteration", False)]
     callbacks_after = [cb for cb in callbacks if not getattr(cb, "before_iteration", False)]
     callbacks_before.sort(key=lambda cb: getattr(cb, "order", 0))
@@ -90,7 +98,11 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
     # main loop (engine.py:190-226)
     finished_iter = num_boost_round
     try:
-        for i in range(num_boost_round):
+        for i in range(start_iter, num_boost_round):
+            # preemption point for the fault-injection harness
+            # (lightgbm_tpu/testing/faults.py): "the pod died after i
+            # completed iterations"
+            faults.inject("train.iteration", iteration=i)
             for cb in callbacks_before:
                 cb(callback_mod.CallbackEnv(model=booster, params=params,
                                             iteration=i, begin_iteration=0,
@@ -105,6 +117,11 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
                 evaluation_result_list.extend(booster.eval_train(feval))
             if valid_sets:
                 evaluation_result_list.extend(booster.eval_valid(feval))
+            if evaluation_result_list:
+                _check_eval_finite(booster, evaluation_result_list, i)
+                booster._inner._eval_history.append(
+                    [[d, m, float(v), bool(b)]
+                     for d, m, v, b in evaluation_result_list])
             try:
                 for cb in callbacks_after:
                     cb(callback_mod.CallbackEnv(model=booster, params=params,
@@ -127,6 +144,102 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
     return booster
 
 
+def _check_eval_finite(booster: Booster, results, iteration: int) -> None:
+    """A NaN metric means the scores (or the metric's own inputs) went
+    bad; every later iteration would train against the same garbage, so
+    stop with a named, located error instead (tpu_guard_nonfinite)."""
+    if not booster._inner.config.boosting.tpu_guard_nonfinite:
+        return
+    for data_name, eval_name, val, _ in results:
+        if not math.isfinite(val):
+            raise LightGBMError(
+                "Metric '%s' on '%s' evaluated to %r at iteration %d; "
+                "the model scores or metric inputs are no longer finite "
+                "(set tpu_guard_nonfinite=false to disable this check)"
+                % (eval_name, data_name, val, iteration))
+
+
+def _setup_checkpointing(booster: Booster, callbacks: List) -> int:
+    """When tpu_checkpoint_dir is set: resume the booster (and any
+    stateful callbacks) from the newest valid snapshot, register the
+    periodic checkpoint callback, and return the iteration to restart
+    the loop from. Returns 0 (fresh start) when checkpointing is off.
+
+    Corrupt/truncated snapshots are skipped to the previous good one
+    (CheckpointManager.load_latest); a snapshot whose config fingerprint
+    differs from this run's is REFUSED loudly — restoring RNG/score
+    state into different training semantics would produce a model that
+    matches neither configuration. Under multi-host training every rank
+    restores its own row-shard snapshot and all ranks agree on the
+    minimum common iteration."""
+    inner = booster._inner
+    cfg = inner.config
+    if not cfg.io.tpu_checkpoint_dir:
+        return 0
+    fingerprint = checkpoint_mod.config_fingerprint(
+        cfg.raw_params, inner._n, inner.max_feature_idx + 1,
+        cfg.boosting_type)
+    manager = checkpoint_mod.CheckpointManager(
+        cfg.io.tpu_checkpoint_dir, keep_last=cfg.io.tpu_checkpoint_keep)
+    stateful = [cb for cb in callbacks if hasattr(cb, "checkpoint_state")]
+
+    start_iter = 0
+    found = manager.load_latest()
+    payload = found[0] if found else None
+    candidate = int(payload["iteration"]) if payload else 0
+    if inner._num_processes > 1:
+        from .parallel.multihost import agree_on_iteration
+        target = agree_on_iteration(candidate)
+        if target <= 0:
+            payload = None  # some rank has no usable snapshot
+        elif target != candidate:
+            try:
+                payload = manager.load_iteration(target)
+            except (checkpoint_mod.CheckpointError, OSError) as exc:
+                # the ranks' snapshot series drifted further apart than
+                # keep-last-K retains; silently diverging (this rank
+                # fresh, others restored) would be far worse than
+                # stopping, so make the operator decide
+                raise LightGBMError(
+                    "Multi-host resume: the ranks agreed on iteration %d "
+                    "but this rank cannot load it (%s). Clear %s on all "
+                    "hosts to restart from scratch, or restore the "
+                    "missing snapshot files." % (target, exc,
+                                                 manager.directory))
+    if payload is not None:
+        path = manager.path_for(int(payload["iteration"]))
+        if payload.get("fingerprint") != fingerprint:
+            raise LightGBMError(
+                "Refusing to resume from %s: its config fingerprint does "
+                "not match this run (parameters, dataset shape or "
+                "boosting type changed since the checkpoint was "
+                "written). Restore the original configuration or point "
+                "tpu_checkpoint_dir at a fresh directory."
+                % path)
+        booster.restore_state(payload)
+        cb_states = payload.get("callbacks", {})
+        for idx, cb in enumerate(stateful):
+            state = cb_states.get(f"{getattr(cb, 'checkpoint_key', 'cb')}:{idx}")
+            if state is not None:
+                cb.restore_state(state)
+        start_iter = int(payload["iteration"])
+        log.info("Resumed training from checkpoint %s at iteration %d",
+                 path, start_iter)
+
+    def _save(env):
+        snapshot = env.model.checkpoint_state()
+        snapshot["fingerprint"] = fingerprint
+        snapshot["callbacks"] = {
+            f"{getattr(cb, 'checkpoint_key', 'cb')}:{idx}":
+                cb.checkpoint_state()
+            for idx, cb in enumerate(stateful)}
+        manager.save(snapshot, snapshot["iteration"])
+
+    callbacks.append(callback_mod.checkpoint(
+        _save, interval=max(1, cfg.io.tpu_checkpoint_interval)))
+    return start_iter
+
+
 def _continue_from(booster: Booster, init_booster: Booster, train_set: Dataset):
     """Seed a new booster's state from a loaded model (reference:
     boosting.cpp:29-62 + application.cpp:112-116 init-score path)."""
@@ -134,6 +247,22 @@ def _continue_from(booster: Booster, init_booster: Booster, train_set: Dataset):
     init_inner = init_booster._inner
     inner.models = copy.deepcopy(init_inner.models)
     inner.iter_ = init_inner.iter_
+    # carry over best-iteration / eval history when the init model has
+    # them (a Booster handed over from a previous train() call): the
+    # continued run starts from the loaded run's record instead of
+    # forgetting where its best model was
+    if getattr(init_booster, "best_iteration", -1) > 0:
+        booster.best_iteration = init_booster.best_iteration
+        booster.best_score = copy.deepcopy(init_booster.best_score)
+    inner.best_iter = dict(getattr(init_inner, "best_iter", {}))
+    inner.best_score = copy.deepcopy(getattr(init_inner, "best_score", {}))
+    inner._eval_history = list(getattr(init_inner, "_eval_history", []))
+    # DART: the drop ledger travels with the model (model text carries
+    # tpu_dart_tree_weights); without it every pre-existing tree would
+    # re-enter drop selection with no weight
+    if hasattr(inner, "tree_weight") and hasattr(init_inner, "tree_weight"):
+        inner.tree_weight = list(init_inner.tree_weight)
+        inner.sum_weight = float(init_inner.sum_weight)
     # the fresh booster's own boost_from_average must be undone — the loaded
     # model's trees (plus its recorded bias) already carry the base score
     if inner.init_score_bias != 0.0:
@@ -142,12 +271,16 @@ def _continue_from(booster: Booster, init_booster: Booster, train_set: Dataset):
     # the loaded trees already carry any boost-from-average bias (AddBias
     # folds it into the first tree) — nothing further to fold
     inner._pending_bias = 0.0
-    # models from reference-format text lack bin-space metadata, and text
-    # never carries the EFB group locators; rebuild from the training
-    # dataset's mappers before binned replay
+    # rebuild bin-space metadata from the TRAINING dataset's mappers
+    # before binned replay: text-loaded trees used to keep their zeroed
+    # group locators here (silently replaying every split through group
+    # 0 on unbundled datasets), and even complete locators only describe
+    # the binning of the dataset the init model was trained on — which
+    # is this one only when the same constructed Dataset is reused
+    same_data = getattr(init_inner, "train_data", None) is inner.train_data
     for tree in inner.models:
         if tree.num_leaves > 1 and (not tree.has_bin_metadata
-                                    or inner.train_data.has_bundles):
+                                    or not same_data):
             tree.attach_bin_metadata(inner.train_data)
     from .boosting.gbdt import _jit_forest_binned
     from .ops.predict import stack_trees

@@ -34,6 +34,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import log
 from ..learner.grow import GrowerConfig, grow_tree
+from ..testing import faults
 
 
 def shard_map_compat(f, mesh, in_specs, out_specs):
@@ -76,6 +77,9 @@ class DataParallelGrower:
 
     def __call__(self, binned, grad, hess, row_weight, feature_mask,
                  fmeta: Dict, n_valid=None):
+        # injection point: a severed/restarting worker surfaces here as
+        # a failed collective dispatch (testing/faults.py)
+        faults.inject("collective.call")
         cfg = self.cfg
         ax = self.axis
         # multi-host: inputs arrive as THIS PROCESS's row shard — assemble
@@ -162,6 +166,7 @@ class FeatureParallelGrower:
 
     def __call__(self, binned, grad, hess, row_weight, feature_mask, fmeta,
                  n_valid=None):
+        faults.inject("collective.call")
         cfg = self.cfg
         ax = self.axis
         from ..learner.grow import FMETA_KEYS, TreeGrowerState

@@ -127,3 +127,20 @@ def global_row_array(local_np, mesh, axis: str):
     sharding = NamedSharding(mesh, P(axis) if local_np.ndim == 1
                              else P(axis, *([None] * (local_np.ndim - 1))))
     return jax.make_array_from_process_local_data(sharding, local_np)
+
+
+def agree_on_iteration(iteration: int) -> int:
+    """Checkpoint resume under multi-host training: every process holds
+    its own row-shard snapshot series, and a preemption can land between
+    one rank's write and another's — so the ranks vote and everyone
+    restarts from the MINIMUM iteration all of them can restore
+    (0 = some rank has nothing usable, start fresh)."""
+    import jax
+    if jax.process_count() <= 1:
+        return int(iteration)
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental import multihost_utils
+    gathered = multihost_utils.process_allgather(
+        jnp.asarray(np.int64(iteration)))
+    return int(np.asarray(gathered).min())

@@ -1,0 +1,122 @@
+"""Fault-injection harness for preemption/IO robustness testing.
+
+TPU pods are preemptible: a long boosting run can die at any iteration,
+an NFS checkpoint write can fail halfway, a collective can be severed by
+a restarting worker. This module simulates those failures
+deterministically so the checkpoint/resume subsystem
+(`lightgbm_tpu/checkpoint.py`) can be tested at tier-1 speed:
+
+- `active(kill_at_iteration=23)` — raise `SimulatedPreemption` when the
+  training loop reaches iteration 23 (after 23 completed iterations),
+  mimicking a SIGKILL between iterations.
+- `active(fail={"checkpoint.write": 2})` — the next 2 calls that pass
+  through the named injection site raise `InjectedFault`; sites are
+  instrumented in checkpoint IO (`checkpoint.write`, `checkpoint.rename`,
+  `checkpoint.read`), the boosting backend (`backend.grow`) and the
+  distributed learners (`collective.call`).
+- `corrupt_file` / `truncate_file` — bit-flip or cut a checkpoint on
+  disk to exercise the checksum-validation / fall-back-to-previous path.
+
+Instrumented code calls `inject(site)` which is a no-op (one `is None`
+check) unless a plan is active, so production runs pay nothing.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Dict, List, Optional
+
+
+class InjectedFault(RuntimeError):
+    """Raised by an armed injection site (stands in for an IOError /
+    severed collective / backend dispatch failure)."""
+
+    def __init__(self, site: str):
+        super().__init__(f"injected fault at site '{site}'")
+        self.site = site
+
+
+class SimulatedPreemption(Exception):
+    """Raised to emulate the process being preempted mid-training."""
+
+    def __init__(self, iteration: int):
+        super().__init__(f"simulated preemption at iteration {iteration}")
+        self.iteration = iteration
+
+
+class FaultPlan:
+    """One active injection schedule (install via `active()`)."""
+
+    def __init__(self, kill_at_iteration: Optional[int] = None,
+                 fail: Optional[Dict[str, int]] = None):
+        self.kill_at_iteration = kill_at_iteration
+        self.fail = dict(fail or {})
+        self.fired: List[str] = []   # audit log of injected faults
+
+
+_plan: Optional[FaultPlan] = None
+
+
+def inject(site: str, iteration: Optional[int] = None) -> None:
+    """Injection point. Called from instrumented production code; no-op
+    unless a plan is active. `iteration` is only consulted by the
+    `train.iteration` site (the engine loop's preemption point)."""
+    if _plan is None:
+        return
+    if (site == "train.iteration"
+            and _plan.kill_at_iteration is not None
+            and iteration is not None
+            and iteration >= _plan.kill_at_iteration):
+        _plan.fired.append(f"kill@{iteration}")
+        raise SimulatedPreemption(iteration)
+    remaining = _plan.fail.get(site, 0)
+    if remaining > 0:
+        _plan.fail[site] = remaining - 1
+        _plan.fired.append(site)
+        raise InjectedFault(site)
+
+
+@contextlib.contextmanager
+def active(kill_at_iteration: Optional[int] = None,
+           fail: Optional[Dict[str, int]] = None):
+    """Arm a fault plan for the duration of the with-block."""
+    global _plan
+    prev = _plan
+    _plan = FaultPlan(kill_at_iteration=kill_at_iteration, fail=fail)
+    try:
+        yield _plan
+    finally:
+        _plan = prev
+
+
+def reset() -> None:
+    global _plan
+    _plan = None
+
+
+# ---------------------------------------------------------------------------
+# on-disk corruption (no plan needed; mutates files directly)
+# ---------------------------------------------------------------------------
+def corrupt_file(path: str, offset: Optional[int] = None,
+                 nbytes: int = 8) -> None:
+    """Flip bits in `nbytes` bytes of the file (default: mid-file, which
+    lands in the checkpoint payload and must trip the checksum)."""
+    size = os.path.getsize(path)
+    if size == 0:
+        return
+    if offset is None:
+        offset = size // 2
+    offset = min(offset, size - 1)
+    with open(path, "r+b") as fh:
+        fh.seek(offset)
+        chunk = fh.read(min(nbytes, size - offset))
+        fh.seek(offset)
+        fh.write(bytes(b ^ 0xFF for b in chunk))
+
+
+def truncate_file(path: str, frac: float = 0.5) -> None:
+    """Cut the file to `frac` of its size (a crash mid-write on a
+    filesystem without atomic rename would look like this)."""
+    size = os.path.getsize(path)
+    with open(path, "r+b") as fh:
+        fh.truncate(max(0, int(size * frac)))

@@ -354,6 +354,14 @@ class Tree:
         out.append("tpu_split_feature_inner=" + " ".join(str(int(x)) for x in self.split_feature_inner[:m]))
         out.append("tpu_nan_bin=" + " ".join(str(int(x)) for x in self.node_nan_bin[:m]))
         out.append("tpu_default_bin=" + " ".join(str(int(x)) for x in self.node_default_bin[:m]))
+        # EFB/group locators: without these a text-loaded tree cannot
+        # traverse the stored (group-major) binned matrix — they used to
+        # be silently zero after load, which corrupted continued-training
+        # score replay on any dataset whose groups aren't all column 0
+        out.append("tpu_node_group=" + " ".join(str(int(x)) for x in self.node_group[:m]))
+        out.append("tpu_node_offset=" + " ".join(str(int(x)) for x in self.node_offset[:m]))
+        out.append("tpu_node_bundled=" + " ".join(str(int(x)) for x in self.node_bundled[:m].astype(np.int32)))
+        out.append("tpu_node_num_bin=" + " ".join(str(int(x)) for x in self.node_num_bin[:m]))
         if self.num_cat > 0:
             out.append("tpu_cat_boundaries_inner=" + " ".join(
                 str(int(x)) for x in self.cat_boundaries_inner[:self.num_cat + 1]))
@@ -387,7 +395,12 @@ class Tree:
             t.right_child = arr("right_child", np.int32, m)
             t.internal_value = arr("internal_value", np.float64, m)
             t.internal_count = arr("internal_count", np.int64, m)
-            t.has_bin_metadata = "tpu_threshold_in_bin" in kv
+            # complete bin metadata needs the group locators too: text
+            # without them (reference models, or models saved before the
+            # locators were serialized) must go through
+            # attach_bin_metadata before binned traversal
+            t.has_bin_metadata = ("tpu_threshold_in_bin" in kv
+                                  and "tpu_node_group" in kv)
             t.threshold_in_bin = arr("tpu_threshold_in_bin", np.int32, m)
             t.split_feature_inner = arr("tpu_split_feature_inner", np.int32, m,
                                         default=-1)
@@ -395,6 +408,10 @@ class Tree:
                 t.split_feature_inner = t.split_feature.copy()
             t.node_nan_bin = arr("tpu_nan_bin", np.int32, m)
             t.node_default_bin = arr("tpu_default_bin", np.int32, m)
+            t.node_group = arr("tpu_node_group", np.int32, m)
+            t.node_offset = arr("tpu_node_offset", np.int32, m)
+            t.node_bundled = arr("tpu_node_bundled", np.int32, m).astype(bool)
+            t.node_num_bin = arr("tpu_node_num_bin", np.int32, m)
             t.node_missing = np.asarray(
                 [t.missing_type_node(i) for i in range(m)], np.int32)
             t.num_cat = int(kv.get("num_cat", 0))

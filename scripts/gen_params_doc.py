@@ -57,6 +57,16 @@ DESCRIPTIONS = {
     "data_filename": "training data path (CLI)",
     "valid_data_filenames": "validation data paths (CLI)",
     "snapshot_freq": "save the model every N iterations",
+    "tpu_checkpoint_dir": "directory for crash-consistent full-state "
+                          "checkpoints (model + RNG + DART ledger + "
+                          "scores + early-stop history); training "
+                          "resumes BIT-IDENTICALLY from the newest "
+                          "valid snapshot on restart (empty = off)",
+    "tpu_checkpoint_interval": "write a checkpoint every N iterations",
+    "tpu_checkpoint_keep": "checkpoints retained per rank (older ones "
+                           "are rotated out; corrupt/truncated "
+                           "snapshots fall back to the previous good "
+                           "one on resume)",
     "is_predict_raw_score": "predict raw scores instead of transformed",
     "is_predict_leaf_index": "predict leaf indices per tree",
     "is_predict_contrib": "predict TreeSHAP feature contributions",
@@ -122,6 +132,10 @@ DESCRIPTIONS = {
     "drop_seed": "DART: seed for the drop choice",
     "top_rate": "GOSS: keep fraction of largest gradients",
     "other_rate": "GOSS: sample fraction of the rest",
+    "tpu_guard_nonfinite": "raise a descriptive error (objective/metric "
+                           "+ iteration) when gradients, hessians or "
+                           "metric values go NaN/Inf instead of "
+                           "silently growing garbage trees",
     # objective
     "is_unbalance": "binary: reweight classes to balance label mass",
     "sigmoid": "sigmoid scale for binary/xentropy objectives",

@@ -25,3 +25,23 @@ if os.environ.get("LGBM_TPU_TEST_PLATFORM", "cpu") == "cpu":
     assert jax.devices()[0].platform == "cpu", \
         "tests must run on the CPU backend"
     assert len(jax.devices()) == 8, "tests expect 8 virtual CPU devices"
+
+
+def pytest_collection_modifyitems(config, items):
+    """Run the robustness suites (checkpoint/resume, fault injection,
+    kill-and-resume cycles) LAST: tier-1 CI runs under a fixed
+    wall-clock budget, and the broad regression coverage must not be
+    displaced past the cutoff by training-heavy robustness cycles."""
+    late_modules = {"tests.test_checkpoint", "tests.test_faults",
+                    "test_checkpoint", "test_faults"}
+    late_tests = {
+        "test_cli_checkpoint_kill_and_resume",
+        "test_continued_training_binned_replay_exact",
+        "test_continue_from_restores_best_iteration",
+        "test_dart_state_roundtrips_through_model_string",
+        "test_goss_state_roundtrips_through_model_string",
+        "test_nonfinite_gradient_guard_names_objective_and_iteration",
+        "test_nonfinite_metric_guard",
+    }
+    items.sort(key=lambda it: it.module.__name__ in late_modules
+               or it.name in late_tests)  # stable sort

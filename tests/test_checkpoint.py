@@ -1,0 +1,359 @@
+"""Preemption-tolerant training: checkpoint/resume with bit-identical
+restart (lightgbm_tpu/checkpoint.py + GBDT.checkpoint_state/restore_state).
+
+The headline contract: train N rounds, kill at round k, rerun the same
+invocation, and the final model STRING is byte-identical to the
+uninterrupted run — across boosting variants (bagging, DART, GOSS, RF)
+and tree learners (serial, data-parallel). The deterministic JAX core
+makes this feasible; these tests are what keeps it true.
+
+Runtime discipline (tier-1 budget): uninterrupted baselines are cached
+per param-set in _BASE_CACHE, and the whole corrupt/truncate matrix
+shares ONE killed run's checkpoint directory (copied per case).
+"""
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import checkpoint as ckpt_mod
+from lightgbm_tpu.testing import faults
+
+
+@pytest.fixture(scope="module")
+def binary_data():
+    rng = np.random.RandomState(0)
+    X = rng.randn(400, 8)
+    y = (X[:, 0] + X[:, 1] * X[:, 2] + rng.randn(400) * 0.3 > 0).astype(float)
+    return X, y
+
+
+def _train(params, X, y, rounds, ckpt_dir=None, kill_at=None, fail=None,
+           valid=None, early_stopping_rounds=None):
+    """One train() invocation; returns the Booster, or None if the
+    simulated preemption (or an injected fault) killed it."""
+    p = dict(params)
+    if ckpt_dir is not None:
+        p.setdefault("tpu_checkpoint_dir", str(ckpt_dir))
+        p.setdefault("tpu_checkpoint_interval", 1)
+    ds = lgb.Dataset(X, y)
+    kwargs = dict(num_boost_round=rounds, verbose_eval=False)
+    if valid is not None:
+        kwargs["valid_sets"] = lgb.Dataset(valid[0], valid[1], reference=ds)
+    if early_stopping_rounds:
+        kwargs["early_stopping_rounds"] = early_stopping_rounds
+    try:
+        if kill_at is not None or fail:
+            with faults.active(kill_at_iteration=kill_at, fail=fail):
+                return lgb.train(p, ds, **kwargs)
+        return lgb.train(p, ds, **kwargs)
+    except (faults.SimulatedPreemption, faults.InjectedFault):
+        return None
+
+
+_BASE_CACHE = {}
+
+
+def _base_string(params, X, y, rounds):
+    """Uninterrupted-run model string, trained once per param-set."""
+    key = (tuple(sorted(params.items())), rounds)
+    if key not in _BASE_CACHE:
+        _BASE_CACHE[key] = _train(params, X, y, rounds).model_to_string()
+    return _BASE_CACHE[key]
+
+
+def _assert_kill_resume_identical(params, X, y, rounds, kill_at, tmp_path):
+    expected = _base_string(params, X, y, rounds)
+    ckpt_dir = tmp_path / "ckpts"
+    assert _train(params, X, y, rounds, ckpt_dir, kill_at=kill_at) is None
+    resumed = _train(params, X, y, rounds, ckpt_dir)
+    assert resumed.model_to_string() == expected
+    return ckpt_dir
+
+
+# ---------------------------------------------------------------------------
+# headline: kill at iteration k, resume, byte-identical final model
+# ---------------------------------------------------------------------------
+def test_kill_resume_bit_identical_dart_bagging_serial(binary_data, tmp_path):
+    """The ISSUE's acceptance test: 50 rounds of bagging+DART, killed at
+    round 23, resumed — byte-identical model (drop ledger, drop RNG,
+    bagging masks and scores all restored exactly)."""
+    X, y = binary_data
+    params = {"objective": "binary", "verbose": -1, "boosting_type": "dart",
+              "bagging_fraction": 0.7, "bagging_freq": 1, "seed": 7,
+              "num_leaves": 7}
+    _assert_kill_resume_identical(params, X, y, 50, 23, tmp_path)
+
+
+def test_kill_resume_bit_identical_dart_bagging_data_parallel(binary_data,
+                                                              tmp_path):
+    X, y = binary_data
+    params = {"objective": "binary", "verbose": -1, "boosting_type": "dart",
+              "bagging_fraction": 0.7, "bagging_freq": 1, "seed": 7,
+              "num_leaves": 7, "tree_learner": "data"}
+    _assert_kill_resume_identical(params, X, y, 12, 5, tmp_path)
+
+
+def test_kill_resume_bit_identical_goss(binary_data, tmp_path):
+    """GOSS's subsample RNG is stateless (fold_in(seed, iteration)), so
+    resume needs no recorded sampler state — asserted via the snapshot's
+    empty extra dict AND the byte-identical model. learning_rate=0.3
+    starts GOSS sampling at iteration ceil(1/0.3)=4, well before the
+    kill."""
+    X, y = binary_data
+    params = {"objective": "binary", "verbose": -1, "boosting_type": "goss",
+              "learning_rate": 0.3, "seed": 5}
+    ckpt_dir = _assert_kill_resume_identical(params, X, y, 14, 7, tmp_path)
+    manager = ckpt_mod.CheckpointManager(str(ckpt_dir))
+    payload, _ = manager.load_latest()
+    assert payload["state"]["extra"] == {}
+
+
+def test_kill_resume_bit_identical_rf(binary_data, tmp_path):
+    X, y = binary_data
+    params = {"objective": "binary", "verbose": -1, "boosting_type": "rf",
+              "bagging_fraction": 0.7, "bagging_freq": 1,
+              "feature_fraction": 0.8, "seed": 9}
+    _assert_kill_resume_identical(params, X, y, 14, 7, tmp_path)
+
+
+def test_kill_resume_early_stopping_state(binary_data, tmp_path):
+    """Early-stopping patience and best-score history survive the
+    restart: the resumed run stops on the SAME iteration with the same
+    best_iteration as the uninterrupted one."""
+    X, y = binary_data
+    Xv, yv = X[:80], y[:80]
+    Xt, yt = X[80:], y[80:]
+    params = {"objective": "binary", "metric": "binary_logloss",
+              "verbose": -1, "seed": 3, "num_leaves": 7}
+    base = _train(params, Xt, yt, 40, valid=(Xv, yv),
+                  early_stopping_rounds=5)
+    ckpt_dir = tmp_path / "ckpts"
+    killed = _train(params, Xt, yt, 40, ckpt_dir, kill_at=10,
+                    valid=(Xv, yv), early_stopping_rounds=5)
+    if killed is None:  # early stop may legitimately fire before round 10
+        resumed = _train(params, Xt, yt, 40, ckpt_dir, valid=(Xv, yv),
+                         early_stopping_rounds=5)
+    else:
+        resumed = killed
+    assert resumed.best_iteration == base.best_iteration
+    assert resumed.model_to_string() == base.model_to_string()
+
+
+# ---------------------------------------------------------------------------
+# corruption matrix: resume must fall back past bad snapshots. All cases
+# share ONE killed run's checkpoints (gbdt + bagging, i.e. the async
+# pipelined serial learner) — each case damages its own copy.
+# ---------------------------------------------------------------------------
+_MATRIX_PARAMS = {"objective": "binary", "verbose": -1,
+                  "bagging_fraction": 0.7, "bagging_freq": 2, "seed": 11}
+_MATRIX_ROUNDS = 16
+
+
+@pytest.fixture(scope="module")
+def killed_run_template(binary_data, tmp_path_factory):
+    X, y = binary_data
+    template = tmp_path_factory.mktemp("ckpt_template")
+    assert _train(_MATRIX_PARAMS, X, y, _MATRIX_ROUNDS, template,
+                  kill_at=7) is None
+    snaps = ckpt_mod.CheckpointManager(str(template)).snapshots()
+    assert [it for it, _ in snaps] == [5, 6, 7]  # keep-last default 3
+    return template
+
+
+def _copy_template(template, tmp_path):
+    dst = tmp_path / "ckpts"
+    shutil.copytree(str(template), str(dst))
+    return dst
+
+
+@pytest.mark.parametrize("damage", ["flip", "truncate", "empty"])
+def test_corrupt_latest_falls_back_to_previous(binary_data, tmp_path,
+                                               killed_run_template, damage):
+    X, y = binary_data
+    ckpt_dir = _copy_template(killed_run_template, tmp_path)
+    latest = ckpt_mod.CheckpointManager(str(ckpt_dir)).snapshots()[-1][1]
+    if damage == "flip":
+        faults.corrupt_file(latest)
+    elif damage == "truncate":
+        faults.truncate_file(latest, frac=0.4)
+    else:
+        with open(latest, "wb"):
+            pass
+    resumed = _train(_MATRIX_PARAMS, X, y, _MATRIX_ROUNDS, ckpt_dir)
+    # fell back to iteration 6, retrained 6..16 — same trajectory
+    assert resumed.model_to_string() == \
+        _base_string(_MATRIX_PARAMS, X, y, _MATRIX_ROUNDS)
+
+
+def test_all_snapshots_corrupt_starts_fresh(binary_data, tmp_path,
+                                            killed_run_template):
+    X, y = binary_data
+    ckpt_dir = _copy_template(killed_run_template, tmp_path)
+    for _, path in ckpt_mod.CheckpointManager(str(ckpt_dir)).snapshots():
+        faults.corrupt_file(path)
+    resumed = _train(_MATRIX_PARAMS, X, y, _MATRIX_ROUNDS, ckpt_dir)
+    assert resumed.model_to_string() == \
+        _base_string(_MATRIX_PARAMS, X, y, _MATRIX_ROUNDS)
+
+
+def test_fingerprint_mismatch_refused(binary_data, tmp_path,
+                                      killed_run_template):
+    """Resuming under a different config would produce a model matching
+    neither run — refuse loudly instead."""
+    X, y = binary_data
+    ckpt_dir = _copy_template(killed_run_template, tmp_path)
+    changed = dict(_MATRIX_PARAMS, learning_rate=0.05)
+    with pytest.raises(lgb.log.LightGBMError, match="fingerprint"):
+        _train(changed, X, y, _MATRIX_ROUNDS, ckpt_dir)
+
+
+def test_fingerprint_ignores_budget_and_output_params(binary_data, tmp_path,
+                                                      killed_run_template):
+    """num_iterations / output paths / the checkpoint knobs themselves
+    don't change the per-iteration trajectory: resuming with a LARGER
+    round budget must extend, not refuse."""
+    X, y = binary_data
+    ckpt_dir = _copy_template(killed_run_template, tmp_path)
+    resumed = _train(_MATRIX_PARAMS, X, y, _MATRIX_ROUNDS + 4, ckpt_dir)
+    assert resumed.model_to_string() == \
+        _base_string(_MATRIX_PARAMS, X, y, _MATRIX_ROUNDS + 4)
+
+
+def test_backend_fault_then_resume(binary_data, tmp_path,
+                                   killed_run_template):
+    """A failed backend dispatch kills the run mid-training; the next
+    invocation resumes from the snapshots already written."""
+    X, y = binary_data
+    ckpt_dir = _copy_template(killed_run_template, tmp_path)
+    # resume attempt dies immediately on a severed backend ...
+    assert _train(_MATRIX_PARAMS, X, y, _MATRIX_ROUNDS, ckpt_dir,
+                  fail={"backend.grow": 1}) is None
+    # ... and the one after that completes, still bit-identical
+    resumed = _train(_MATRIX_PARAMS, X, y, _MATRIX_ROUNDS, ckpt_dir)
+    assert resumed.model_to_string() == \
+        _base_string(_MATRIX_PARAMS, X, y, _MATRIX_ROUNDS)
+
+
+# ---------------------------------------------------------------------------
+# fault injection: IO and collective failures
+# ---------------------------------------------------------------------------
+def test_checkpoint_write_failure_does_not_kill_training(binary_data,
+                                                         tmp_path):
+    """A transient filesystem error loses one snapshot, not the run."""
+    X, y = binary_data
+    params = {"objective": "binary", "verbose": -1, "seed": 7}
+    ckpt_dir = tmp_path / "ckpts"
+    booster = _train(params, X, y, 8, ckpt_dir,
+                     fail={"checkpoint.write": 3})
+    assert booster is not None  # injected write failures were swallowed
+    assert booster.model_to_string() == _base_string(params, X, y, 8)
+    manager = ckpt_mod.CheckpointManager(str(ckpt_dir))
+    assert len(manager.snapshots()) >= 1  # later writes succeeded
+
+
+def test_collective_fault_surfaces_in_data_parallel(binary_data):
+    X, y = binary_data
+    params = {"objective": "binary", "verbose": -1, "seed": 7,
+              "tree_learner": "data"}
+    assert _train(params, X, y, 4, fail={"collective.call": 1}) is None
+
+
+# ---------------------------------------------------------------------------
+# snapshot store unit tests
+# ---------------------------------------------------------------------------
+def test_manager_rotation_keeps_last_k(tmp_path):
+    manager = ckpt_mod.CheckpointManager(str(tmp_path), keep_last=2)
+    for it in range(1, 6):
+        manager.save({"iteration": it}, it)
+    assert manager.available_iterations() == [4, 5]
+    payload, path = manager.load_latest()
+    assert payload["iteration"] == 5
+    assert path.endswith("ckpt_00000005.r0")
+
+
+def test_manager_rejects_newer_format_version(tmp_path):
+    manager = ckpt_mod.CheckpointManager(str(tmp_path))
+    path = manager.save({"iteration": 1}, 1)
+    data = open(path, "rb").read().replace(b"LGBMTPU-CKPT/1",
+                                           b"LGBMTPU-CKPT/9")
+    with open(path, "wb") as fh:
+        fh.write(data)
+    with pytest.raises(ckpt_mod.CheckpointError, match="version"):
+        manager.load(path)
+    assert manager.load_latest() is None
+
+
+def test_manager_checksum_catches_single_bit_flip(tmp_path):
+    manager = ckpt_mod.CheckpointManager(str(tmp_path))
+    path = manager.save({"iteration": 1, "blob": "x" * 1000}, 1)
+    faults.corrupt_file(path, offset=os.path.getsize(path) - 10, nbytes=1)
+    with pytest.raises(ckpt_mod.CheckpointError, match="checksum"):
+        manager.load(path)
+
+
+def test_array_and_rng_codecs_roundtrip():
+    arr = np.random.RandomState(0).randn(3, 7).astype(np.float32)
+    dec = ckpt_mod.decode_array(ckpt_mod.encode_array(arr))
+    assert dec.dtype == arr.dtype and (dec == arr).all()
+    rng = np.random.RandomState(123)
+    rng.rand(17)  # advance mid-sequence
+    clone = ckpt_mod.decode_rng(ckpt_mod.encode_rng(rng))
+    assert (clone.rand(50) == rng.rand(50)).all()
+
+
+# ---------------------------------------------------------------------------
+# atomic model save (satellite: interrupt can't truncate a model file)
+# ---------------------------------------------------------------------------
+def test_save_model_atomic_on_failed_rename(binary_data, tmp_path):
+    X, y = binary_data
+    params = {"objective": "binary", "verbose": -1, "seed": 7}
+    booster = _train(params, X, y, 3)
+    path = str(tmp_path / "model.txt")
+    booster.save_model(path)
+    original = open(path, "rb").read()
+    more = _train(params, X, y, 6)
+    with faults.active(fail={"checkpoint.rename": 1}):
+        with pytest.raises(faults.InjectedFault):
+            more.save_model(path)
+    # the interrupted save left the previous model fully intact and no
+    # tmp litter behind
+    assert open(path, "rb").read() == original
+    assert [f for f in os.listdir(tmp_path) if ".tmp." in f] == []
+    more.save_model(path)
+    assert open(path, "rb").read() != original
+
+
+def test_guard_error_not_swallowed_by_checkpoint_callback(tmp_path):
+    """The checkpoint callback swallows IO-shaped write failures only;
+    a non-finite-gradient guard error raised inside the state capture's
+    pipeline flush is a TRAINING error and must kill the run (an early
+    version caught it as a generic write failure and kept training on a
+    desynced booster)."""
+    rng = np.random.RandomState(0)
+    X = rng.randn(300, 5)
+    y = np.abs(X[:, 0]) + 0.1
+    params = {"objective": "poisson", "verbose": -1, "learning_rate": 50.0,
+              "tpu_checkpoint_dir": str(tmp_path / "ckpts"),
+              "tpu_checkpoint_interval": 1}
+    with pytest.raises(lgb.log.LightGBMError, match="non-finite"):
+        lgb.train(params, lgb.Dataset(X, y), num_boost_round=10,
+                  verbose_eval=False)
+
+
+def test_manager_sweeps_stale_tmp_files(tmp_path):
+    """A real SIGKILL between mkstemp and rename orphans a tmp file;
+    the next manager (the resumed run) must reclaim it — and must not
+    touch other ranks' in-flight files."""
+    manager = ckpt_mod.CheckpointManager(str(tmp_path))
+    manager.save({"iteration": 1}, 1)
+    mine = tmp_path / "ckpt_00000002.r0.tmp.abc123"
+    theirs = tmp_path / "ckpt_00000002.r1.tmp.def456"
+    mine.write_bytes(b"partial")
+    theirs.write_bytes(b"partial")
+    ckpt_mod.CheckpointManager(str(tmp_path))  # rank-0 startup sweep
+    assert not mine.exists()
+    assert theirs.exists()
+    assert manager.available_iterations() == [1]

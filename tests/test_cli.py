@@ -216,3 +216,30 @@ def test_python_guide_simple_example(tmp_path):
                          timeout=600)
     assert out.returncode == 0, out.stderr[-2000:]
     assert "RMSE of prediction is" in out.stdout
+
+
+def test_cli_checkpoint_kill_and_resume(data_files):
+    """CLI-driven preemption tolerance: the same command line, rerun
+    after a mid-training kill, resumes from tpu_checkpoint_dir and
+    produces a byte-identical model file."""
+    from lightgbm_tpu.cli import main
+    from lightgbm_tpu.testing import faults
+    tmp_path, train_path, _ = data_files
+    base_model = tmp_path / "model_base.txt"
+    args = [f"data={train_path}", "objective=binary", "num_trees=8",
+            "num_leaves=7", "boosting_type=dart", "bagging_fraction=0.7",
+            "bagging_freq=1", "seed=5", "verbose=-1"]
+    assert main(args + [f"output_model={base_model}"]) == 0
+
+    model = tmp_path / "model.txt"
+    ckpt_dir = tmp_path / "ckpts"
+    resumable = args + [f"output_model={model}",
+                        f"tpu_checkpoint_dir={ckpt_dir}",
+                        "tpu_checkpoint_interval=1"]
+    with faults.active(kill_at_iteration=3):
+        with pytest.raises(faults.SimulatedPreemption):
+            main(resumable)
+    assert not os.path.exists(model)
+    assert len(os.listdir(ckpt_dir)) > 0
+    assert main(resumable) == 0
+    assert model.read_bytes() == base_model.read_bytes()

@@ -426,3 +426,141 @@ def test_pipeline_drains_before_explicit_gradient_update():
     # monotone-refining, so earlier trees have the larger value spread
     spreads = [float(np.ptp(t.leaf_value)) for t in models]
     assert spreads[0] >= spreads[2] * 0.5  # sanity: ordered, not swapped
+
+
+def test_continued_training_binned_replay_exact(breast_cancer):
+    """Regression: text-loaded trees used to keep zeroed EFB/group
+    locators, so _continue_from silently replayed every split through
+    stored column 0 on unbundled datasets (diff of ~3 raw-score units);
+    the locators now ride in the model text."""
+    X, y = breast_cancer
+    params = {"objective": "binary", "verbose": -1, "num_leaves": 7}
+    gbm1 = lgb.train(params, lgb.Dataset(X, y), num_boost_round=8,
+                     verbose_eval=False)
+    gbm2 = lgb.train(params, lgb.Dataset(X, y), num_boost_round=0,
+                     init_model=lgb.Booster(model_str=gbm1.model_to_string(),
+                                            params=params),
+                     verbose_eval=False)
+    replayed = gbm2._inner._train_score_unpadded()
+    predicted = gbm1.predict(X, raw_score=True)
+    assert np.allclose(replayed, predicted, atol=1e-4)
+
+
+def test_continue_from_restores_best_iteration(breast_cancer):
+    """Satellite regression: init_model carrying best_iteration /
+    best_score / eval history hands them to the continued booster
+    instead of resetting them to -1/{}."""
+    X, y = breast_cancer
+    X_train, y_train, X_test, y_test = _split(X, y)
+    params = {"objective": "binary", "metric": "binary_logloss",
+              "verbose": -1, "num_leaves": 7}
+    lgb_train = lgb.Dataset(X_train, y_train)
+    gbm1 = lgb.train(params, lgb_train, num_boost_round=40,
+                     valid_sets=lgb.Dataset(X_test, y_test,
+                                            reference=lgb_train),
+                     early_stopping_rounds=3, verbose_eval=False)
+    assert gbm1.best_iteration > 0
+    history_len = len(gbm1._inner._eval_history)
+    assert history_len > 0
+    gbm2 = lgb.train(params, lgb.Dataset(X_train, y_train),
+                     num_boost_round=3, init_model=gbm1,
+                     verbose_eval=False)
+    assert gbm2.best_iteration == gbm1.best_iteration
+    assert gbm2.best_score == gbm1.best_score
+    # carried history stays, and the new run appends nothing here (no
+    # valid sets attached to the continuation)
+    assert gbm2._inner._eval_history[:history_len] == \
+        gbm1._inner._eval_history
+
+
+def test_dart_state_roundtrips_through_model_string(breast_cancer):
+    """Satellite: the DART drop ledger (tree weights + running sum)
+    survives model_to_string/load_model_from_string exactly, and
+    re-serializing reproduces the same bytes."""
+    X, y = breast_cancer
+    params = {"objective": "binary", "verbose": -1, "num_leaves": 7,
+              "boosting_type": "dart", "seed": 3}
+    gbm = lgb.train(params, lgb.Dataset(X, y), num_boost_round=10,
+                    verbose_eval=False)
+    inner = gbm._inner
+    assert len(inner.tree_weight) == 10
+    text = gbm.model_to_string()
+    assert "tpu_dart_tree_weights=" in text
+    loaded = lgb.Booster(model_str=text, params=dict(params))
+    assert type(loaded._inner).__name__ == "DART"
+    assert loaded._inner.tree_weight == inner.tree_weight
+    assert loaded._inner.sum_weight == inner.sum_weight
+    assert loaded.model_to_string() == text
+
+
+def test_goss_state_roundtrips_through_model_string(breast_cancer):
+    """Satellite: GOSS models round-trip to the GOSS class; the
+    subsample RNG is stateless (pure function of seed+iteration), so
+    identical calls produce identical device masks."""
+    X, y = breast_cancer
+    params = {"objective": "binary", "verbose": -1, "num_leaves": 7,
+              "boosting_type": "goss", "learning_rate": 0.3, "seed": 3}
+    gbm = lgb.train(params, lgb.Dataset(X, y), num_boost_round=10,
+                    verbose_eval=False)
+    text = gbm.model_to_string()
+    loaded = lgb.Booster(model_str=text, params=dict(params))
+    assert type(loaded._inner).__name__ == "GOSS"
+    assert loaded.model_to_string() == text
+    from lightgbm_tpu.boosting.goss import _goss_weights_device
+    import jax.numpy as jnp
+    g = jnp.asarray(np.linspace(-1, 1, 64, dtype=np.float32))
+    h = jnp.abs(g) + 0.1
+    w1 = np.asarray(_goss_weights_device(g, h, 3, 12, 1, 64, 64, 13, 6))
+    w2 = np.asarray(_goss_weights_device(g, h, 3, 12, 1, 64, 64, 13, 6))
+    assert (w1 == w2).all()
+
+
+def test_nonfinite_gradient_guard_names_objective_and_iteration(boston):
+    """Satellite: NaN gradients raise a descriptive error instead of
+    silently growing garbage trees."""
+    X, y = boston
+    y = y.copy()
+    y[3] = np.nan
+
+    # boost_from_average would already turn the bias into NaN; keep the
+    # guard the first thing that trips
+    params = {"objective": "regression", "verbose": -1,
+              "boost_from_average": False}
+    with pytest.raises(lgb.log.LightGBMError,
+                       match=r"regression.*non-finite.*iteration 0"):
+        lgb.train(params, lgb.Dataset(X, y), num_boost_round=5,
+                  verbose_eval=False)
+
+    # custom-objective path (explicit gradient arrays)
+    def bad_fobj(preds, train_data):
+        g = np.full(len(preds), np.inf, np.float32)
+        return g, np.ones_like(g)
+
+    good = np.random.RandomState(0).randn(len(y))
+    with pytest.raises(lgb.log.LightGBMError, match="custom"):
+        lgb.train({"objective": "none", "verbose": -1},
+                  lgb.Dataset(X, good), num_boost_round=3,
+                  fobj=bad_fobj, verbose_eval=False)
+
+    # opt-out: guard disabled trains without raising
+    off = dict(params, tpu_guard_nonfinite=False)
+    booster = lgb.train(off, lgb.Dataset(X, y), num_boost_round=3,
+                        verbose_eval=False)
+    assert booster.num_trees() >= 0
+
+
+def test_nonfinite_metric_guard(boston):
+    """Satellite: a metric evaluating to NaN/Inf stops training with the
+    metric and iteration named."""
+    X, y = boston
+
+    def nan_metric(preds, ds):
+        return ("custom_metric", float("nan"), False)
+
+    params = {"objective": "regression", "metric": "l2", "verbose": -1}
+    ds = lgb.Dataset(X, y)
+    with pytest.raises(lgb.log.LightGBMError,
+                       match=r"custom_metric.*iteration 0"):
+        lgb.train(params, ds, num_boost_round=5,
+                  valid_sets=lgb.Dataset(X, y, reference=ds),
+                  feval=nan_metric, verbose_eval=False)

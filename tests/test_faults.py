@@ -1,0 +1,77 @@
+"""Fault-injection harness unit tests (lightgbm_tpu/testing/faults.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.testing import faults
+
+
+def test_inject_is_noop_without_plan():
+    faults.reset()
+    faults.inject("checkpoint.write")
+    faults.inject("train.iteration", iteration=5)
+
+
+def test_fail_counter_decrements_and_exhausts():
+    with faults.active(fail={"some.site": 2}) as plan:
+        with pytest.raises(faults.InjectedFault):
+            faults.inject("some.site")
+        with pytest.raises(faults.InjectedFault):
+            faults.inject("some.site")
+        faults.inject("some.site")  # exhausted: passes through
+        assert plan.fired == ["some.site", "some.site"]
+    faults.inject("some.site")  # plan uninstalled
+
+
+def test_kill_at_iteration_fires_at_and_after_k():
+    with faults.active(kill_at_iteration=3):
+        faults.inject("train.iteration", iteration=2)
+        with pytest.raises(faults.SimulatedPreemption) as exc:
+            faults.inject("train.iteration", iteration=3)
+        assert exc.value.iteration == 3
+        # a retried loop must ALSO die (the pod is gone, not flaky)
+        with pytest.raises(faults.SimulatedPreemption):
+            faults.inject("train.iteration", iteration=7)
+
+
+def test_plans_nest_and_restore():
+    with faults.active(fail={"a": 1}):
+        with faults.active(fail={"b": 1}):
+            faults.inject("a")  # inner plan doesn't know site "a"
+            with pytest.raises(faults.InjectedFault):
+                faults.inject("b")
+        with pytest.raises(faults.InjectedFault):
+            faults.inject("a")  # outer plan restored
+
+
+def test_corrupt_file_flips_bytes(tmp_path):
+    path = str(tmp_path / "f.bin")
+    payload = bytes(range(256)) * 4
+    with open(path, "wb") as fh:
+        fh.write(payload)
+    faults.corrupt_file(path, offset=10, nbytes=4)
+    mutated = open(path, "rb").read()
+    assert len(mutated) == len(payload)
+    assert mutated[10:14] != payload[10:14]
+    assert mutated[:10] == payload[:10] and mutated[14:] == payload[14:]
+
+
+def test_truncate_file_cuts(tmp_path):
+    path = str(tmp_path / "f.bin")
+    with open(path, "wb") as fh:
+        fh.write(b"x" * 100)
+    faults.truncate_file(path, frac=0.3)
+    assert os.path.getsize(path) == 30
+
+
+def test_simulated_preemption_kills_training_mid_run():
+    rng = np.random.RandomState(0)
+    X = rng.randn(200, 5)
+    y = (X[:, 0] > 0).astype(float)
+    params = {"objective": "binary", "verbose": -1}
+    with faults.active(kill_at_iteration=4):
+        with pytest.raises(faults.SimulatedPreemption):
+            lgb.train(params, lgb.Dataset(X, y), num_boost_round=20,
+                      verbose_eval=False)
