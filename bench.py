@@ -332,11 +332,104 @@ def run_amortized(rows=None, iters=None) -> dict:
     }
 
 
+def run_predict() -> list:
+    """Serving predict benchmarks (BENCH_SHAPE=predict): bulk throughput
+    over one large matrix and repeated small-batch latency — the two
+    serving steady states. The small-batch detail carries the speedup
+    over the per-call-restack seed behavior (tpu_predict_cache=false +
+    no buckets + no pipeline), the number the device-resident
+    CompiledForest cache exists for."""
+    import lightgbm_tpu as lgb
+
+    train_rows = int(os.environ.get("BENCH_PREDICT_TRAIN_ROWS", 50_000))
+    trees = int(os.environ.get("BENCH_PREDICT_TREES", 500))
+    bulk_rows = int(os.environ.get("BENCH_PREDICT_ROWS", 1_000_000))
+    reps = int(os.environ.get("BENCH_PREDICT_REPS", 100))
+    batch = int(os.environ.get("BENCH_PREDICT_BATCH", 8))
+
+    X, y = synth_higgs(train_rows, N_FEATURES)
+    params = {
+        "objective": "binary", "verbose": -1, "max_bin": MAX_BIN,
+        "num_leaves": 31, "learning_rate": 0.1, "min_data_in_leaf": 1,
+        "min_sum_hessian_in_leaf": 100.0,
+    }
+    ds = lgb.Dataset(X, y, params=dict(params))
+    ds.construct()
+    t0 = time.time()
+    booster = lgb.train(dict(params), ds, num_boost_round=trees,
+                        verbose_eval=False)
+    train_s = time.time() - t0
+    model_str = booster.model_to_string()
+    num_trees = booster.num_trees()
+
+    out = []
+    # ---- bulk throughput ------------------------------------------------
+    Xb, _ = synth_higgs(bulk_rows, N_FEATURES, seed=7)
+    predictor = booster.serving_predictor(raw_score=True)
+    # one full untimed pass: compiles every bucket program the bulk scan
+    # uses (including the full-chunk bucket) + stacks the forest, so the
+    # timed pass is pure steady-state dispatch
+    predictor.predict(Xb)
+    t0 = time.time()
+    predictor.predict(Xb)
+    bulk_s = time.time() - t0
+    out.append({
+        "metric": "predict_bulk_throughput",
+        "value": round(bulk_rows / bulk_s / 1e6, 4),
+        "unit": "mrows/s",
+        "vs_baseline": 1.0,
+        "detail": {"rows": bulk_rows, "trees": num_trees,
+                   "train_seconds": round(train_s, 1),
+                   "bulk_seconds": round(bulk_s, 3)},
+    })
+
+    # ---- repeated small-batch latency ----------------------------------
+    predictor.warmup(max_rows=max(batch, 16))
+    lats = []
+    for i in range(reps):
+        sl = Xb[(i * batch) % 4096:(i * batch) % 4096 + batch]
+        t0 = time.perf_counter()
+        predictor.predict(sl)
+        lats.append(time.perf_counter() - t0)
+    lats.sort()
+    p50 = lats[len(lats) // 2]
+
+    # seed behavior: restack + retrace per call
+    seed_booster = lgb.Booster(model_str=model_str, params={
+        "tpu_predict_cache": "false", "tpu_predict_bucket_min": 0,
+        "tpu_predict_pipeline": "false"})
+    seed_reps = max(3, min(10, reps // 10))
+    seed_lats = []
+    for i in range(seed_reps):
+        sl = Xb[i * batch:(i + 1) * batch]
+        t0 = time.perf_counter()
+        seed_booster.predict(sl, raw_score=True)
+        seed_lats.append(time.perf_counter() - t0)
+    seed_lats.sort()
+    seed_p50 = seed_lats[len(seed_lats) // 2]
+    out.append({
+        "metric": "predict_small_batch_p50_latency",
+        "value": round(p50 * 1e3, 4),
+        "unit": "ms",
+        "vs_baseline": 1.0,
+        "detail": {"batch_rows": batch, "reps": reps, "trees": num_trees,
+                   "p50_seed_percall_restack_ms": round(seed_p50 * 1e3, 3),
+                   "speedup_vs_percall_restack":
+                       round(seed_p50 / max(p50, 1e-12), 2),
+                   "restacks": predictor.stats().get("stack_restacks")},
+    })
+    return out
+
+
 def main():
     _init_backend_with_retry()
     which = os.environ.get("BENCH_SHAPE", "higgs")
     if which == "amortized":
         print(json.dumps(run_amortized()), flush=True)
+        return
+    if which == "predict":
+        for entry in run_predict():
+            print(json.dumps(entry), flush=True)
         return
     names = list(SHAPES) if which == "all" else [which]
     for name in names:

@@ -23,6 +23,10 @@ from .objectives import create_objective
 
 LightGBMError = log.LightGBMError
 
+# one-time (per process) acknowledgement that data_has_header/is_reshape
+# have no effect in this build (see Booster.predict)
+_PREDICT_COMPAT_WARNED = False
+
 
 def _data_to_2d(data) -> np.ndarray:
     if isinstance(data, str):
@@ -412,6 +416,8 @@ class Booster:
         if "objective" in params:
             self._inner.objective = create_objective(cfg)
         self._metric_names = []
+        # the shared Predictor (if any) is bound to the replaced engine
+        self._serving_default = None
 
     # ------------------------------------------------------------------
     def _reset_training_data(self, train_set: Dataset) -> "Booster":
@@ -473,6 +479,8 @@ class Booster:
         for vi, vs in enumerate(getattr(old, "valid_sets", [])):
             fresh.add_valid(vs, old.valid_names[vi], self._metric_names)
         self._inner = fresh
+        # the shared Predictor (if any) is bound to the replaced engine
+        self._serving_default = None
         return self
 
     def add_valid(self, data: Dataset, name: str) -> "Booster":
@@ -573,17 +581,49 @@ class Booster:
         return out
 
     # ------------------------------------------------------------------
+    def serving_predictor(self, **kwargs) -> "Predictor":
+        """A serving front end bound to this booster (reference:
+        Predictor, predictor.hpp:24-205): warmup over the bucket
+        ladder, micro-batching of concurrent requests, and
+        latency/throughput/cache counters. Kwargs fix the default
+        predict arguments (num_iteration, raw_score, ...)."""
+        from .serving import Predictor
+        return Predictor(self, **kwargs)
+
+    def _serving(self) -> "Predictor":
+        """Shared default Predictor every Booster.predict routes
+        through, so serving counters accumulate per booster."""
+        p = getattr(self, "_serving_default", None)
+        if p is None:
+            p = self.serving_predictor()
+            self._serving_default = p
+        return p
+
     def predict(self, data, num_iteration: int = -1, raw_score: bool = False,
                 pred_leaf: bool = False, pred_contrib: bool = False,
                 data_has_header: bool = False, is_reshape: bool = True,
                 pred_early_stop: bool = False, pred_early_stop_freq: int = 10,
                 pred_early_stop_margin: float = 10.0):
+        # data_has_header only applies to file inputs the reference CLI
+        # parses and is_reshape to its flat C-API outputs; neither has an
+        # effect here (files are parsed headers-and-all by load_data_file
+        # and outputs are already [n, k]-shaped). Acknowledge the knob
+        # once instead of silently ignoring it.
+        global _PREDICT_COMPAT_WARNED
+        if (data_has_header or not is_reshape) and not _PREDICT_COMPAT_WARNED:
+            _PREDICT_COMPAT_WARNED = True
+            log.warning(
+                "Booster.predict ignores data_has_header/is_reshape: "
+                "file inputs are parsed by the loader directly and "
+                "outputs are always reshaped to [num_data, num_class] "
+                "(warned once)")
         arr = _data_to_2d(data)
-        return self._inner.predict(arr, num_iteration, raw_score, pred_leaf,
-                                   pred_contrib,
-                                   pred_early_stop=pred_early_stop,
-                                   pred_early_stop_freq=pred_early_stop_freq,
-                                   pred_early_stop_margin=pred_early_stop_margin)
+        return self._serving().predict(
+            arr, num_iteration=num_iteration, raw_score=raw_score,
+            pred_leaf=pred_leaf, pred_contrib=pred_contrib,
+            pred_early_stop=pred_early_stop,
+            pred_early_stop_freq=pred_early_stop_freq,
+            pred_early_stop_margin=pred_early_stop_margin)
 
     # ------------------------------------------------------------------
     # checkpoint/resume (lightgbm_tpu/checkpoint.py): the payload wraps

@@ -182,6 +182,9 @@ class DART(GBDT):
             self.tree_weight.append(self.shrinkage_rate)
             self.sum_weight += self.shrinkage_rate
             self._normalize()
+            # _normalize rescales EXISTING trees' leaf values in place —
+            # a stacked forest cached after the append would be stale
+            self._bump_model_version()
         else:
             # restore dropped trees to the train score
             for i in self.drop_index:

@@ -35,42 +35,53 @@ _K_EPSILON = 1e-15
 _SUBTRACT_CACHE_BUDGET = 256 << 20
 
 
-_forest_raw_jit = None
-_forest_binned_jit = None
+_forest_jit_cache: Dict[str, object] = {}
+
+
+def _forest_jit(fn_name: str, static=()):
+    """Memoized module-level jax.jit of ops.predict.<fn_name>: one
+    jitted dispatch over the stacked ensemble instead of one per tree
+    (compiled once per (num_trees, max_nodes, num_rows) shape). The
+    cache is module-global so traces survive across calls and boosters
+    (a fresh jax.jit per call would retrace every time)."""
+    f = _forest_jit_cache.get(fn_name)
+    if f is None:
+        import jax
+
+        from ..ops import predict as predict_ops
+        f = jax.jit(getattr(predict_ops, fn_name),
+                    static_argnames=tuple(static) or None)
+        _forest_jit_cache[fn_name] = f
+    return f
 
 
 def _jit_forest_raw(stacked, data):
-    """One jitted scan over the stacked ensemble instead of a dispatch per
-    tree (compiled once per (num_trees, max_nodes, num_rows) shape). The
-    jit wrapper is module-global so its trace cache survives across calls
-    (a fresh jax.jit per call would retrace every time)."""
-    import jax
-    from ..ops.predict import predict_forest_raw
-    global _forest_raw_jit
-    if _forest_raw_jit is None:
-        _forest_raw_jit = jax.jit(predict_forest_raw)
-    return _forest_raw_jit(stacked, data)
+    return _forest_jit("predict_forest_raw")(stacked, data)
 
 
 def _jit_forest_binned(stacked, binned):
-    import jax
-    from ..ops.predict import predict_forest_binned
-    global _forest_binned_jit
-    if _forest_binned_jit is None:
-        _forest_binned_jit = jax.jit(predict_forest_binned)
-    return _forest_binned_jit(stacked, binned)
-
-
-_forest_raw_matmul_jit = None
+    return _forest_jit("predict_forest_binned")(stacked, binned)
 
 
 def _jit_forest_raw_matmul(mf, data):
-    import jax
-    from ..ops.predict import predict_forest_raw_matmul
-    global _forest_raw_matmul_jit
-    if _forest_raw_matmul_jit is None:
-        _forest_raw_matmul_jit = jax.jit(predict_forest_raw_matmul)
-    return _forest_raw_matmul_jit(mf, data)
+    return _forest_jit("predict_forest_raw_matmul")(mf, data)
+
+
+def _jit_forest_leaf_matmul(mf, data):
+    return _forest_jit("predict_forest_leaf_matmul")(mf, data)
+
+
+def _jit_forest_leaf_raw(stacked, data):
+    return _forest_jit("predict_forest_leaf_raw")(stacked, data)
+
+
+def _jit_forest_es(stacked_kt, data, margin, freq):
+    """Margin-based early-stop forest walk (freq is static: it feeds a
+    `t % freq` under the iteration while_loop; margin stays a traced
+    scalar so sweeping it does not retrace)."""
+    import jax.numpy as jnp
+    return _forest_jit("predict_forest_raw_early_stop", static=("freq",))(
+        stacked_kt, data, jnp.float32(margin), freq=freq)
 
 
 def _pad_to(arr: np.ndarray, n: int, value=0):
@@ -262,6 +273,11 @@ class GBDT:
         # with it (measured ~130 ms/iter of pure dispatch/fetch latency
         # at 500k rows — more than the device time of the iteration)
         self._pending_small = None
+        # device-resident stacked-forest cache (serving/forest.py):
+        # every ensemble mutation must go through _bump_model_version()
+        # so a cached stack can never outlive the model it was built from
+        from ..serving.forest import CompiledForest
+        self._compiled_forest = CompiledForest()
 
     # ------------------------------------------------------------------
     def init(self, train_data: Dataset, objective: Optional[ObjectiveFunction],
@@ -926,6 +942,7 @@ class GBDT:
         tree = self._materialize_small(small, shrink)
         if tree.num_leaves > 1:
             self.models.append(tree)
+            self._bump_model_version()
             # a splitting tree clears any stale stop latch: the latch
             # exists to carry a pending stop across a drain, not to
             # poison later successful iterations (a fresh bag can open
@@ -945,6 +962,22 @@ class GBDT:
         """Drain the async pipeline (engine.train calls this after the
         boosting loop; model/prediction readers call it defensively)."""
         self._flush_pending()
+
+    # ------------------------------------------------------------------
+    # model-version bookkeeping (serving/forest.py): EVERY ensemble
+    # mutation — tree append, rollback, model load, checkpoint restore,
+    # continued training, DART re-normalization — must route through
+    # here so device-resident stacked forests can never serve a stale
+    # model. The version only ever increases.
+    def _bump_model_version(self) -> None:
+        self._compiled_forest.invalidate()
+
+    def model_version(self) -> int:
+        """Monotonic counter identifying the current ensemble contents
+        (drains the async tree pipeline first, like num_trees(): a
+        pending tree is part of the model the next predict serves)."""
+        self.finalize_training()
+        return self._compiled_forest.version
 
     # ------------------------------------------------------------------
     # NaN/Inf gradient guard
@@ -984,6 +1017,8 @@ class GBDT:
     def _finish_iter(self, could_split_any: bool) -> bool:
         """Advance the iteration counter, rolling the whole iteration
         back when no class tree could split (gbdt.cpp:466-472)."""
+        # trees were appended (or are about to be popped) either way
+        self._bump_model_version()
         self.iter_ += 1
         if not could_split_any:
             for _ in range(self.num_tree_per_iteration):
@@ -1048,6 +1083,7 @@ class GBDT:
                     self._valid_score[vi] = self._valid_score[vi].at[cls].add(
                         predict_value_binned(dtree, self._valid_binned[vi]))
         self.iter_ -= 1
+        self._bump_model_version()
 
     # ------------------------------------------------------------------
     def eval_once(self) -> List[Tuple[str, str, float, bool]]:
@@ -1096,6 +1132,64 @@ class GBDT:
     _PREDICT_ROW_CHUNK = 1 << 17
     _PREDICT_ROW_CHUNK_MATMUL = 1 << 19
 
+    def _capped_total(self, num_iteration: int) -> int:
+        """Trees used under a num_iteration cap (shared by the value,
+        leaf, and early-stop prediction routes — they used to slice
+        `self.models` independently)."""
+        total = len(self.models)
+        if num_iteration > 0:
+            total = min(total, num_iteration * self.num_tree_per_iteration)
+        return total
+
+    def _forest_cache(self):
+        """The CompiledForest cache with its enable bit refreshed from
+        config (tpu_predict_cache=false reproduces the per-call-restack
+        seed behavior for A/B timing)."""
+        self._compiled_forest.enabled = bool(self.config.io.tpu_predict_cache)
+        return self._compiled_forest
+
+    def _predict_chunk_rows(self, default: int) -> int:
+        c = int(self.config.io.tpu_predict_chunk)
+        return c if c > 0 else default
+
+    def _bucket_size(self, nrows: int, cap: int) -> int:
+        from ..serving.forest import bucket_rows
+        return bucket_rows(nrows, int(self.config.io.tpu_predict_bucket_min),
+                           cap)
+
+    def _pipelined_chunks(self, data: np.ndarray, chunk: int,
+                          dispatch, fetch) -> None:
+        """Double-buffered row-chunk loop: dispatch chunk k+1 BEFORE
+        fetching chunk k, so chunk k's D2H fetch overlaps chunk k+1's
+        H2D/compute instead of serializing with it (jax dispatch is
+        async; the blocking call is the fetch). Each chunk's row count
+        is padded up the bucket ladder so the remainder chunk reuses a
+        compiled program instead of retracing — every prediction kernel
+        is row-independent, so the padding is sliced off at fetch with
+        bit-identical results. `dispatch(dj)` returns unfetched device
+        value(s); `fetch(sl, nrows, dev)` materializes them."""
+        import jax.numpy as jnp
+
+        from .. import tracing
+        from ..serving.forest import pad_rows
+        n = data.shape[0]
+        pipeline = bool(self.config.io.tpu_predict_pipeline)
+        pending = None
+        for i in range(0, n, chunk):
+            nrows = min(chunk, n - i)
+            bucket = self._bucket_size(nrows, chunk)
+            dj = jnp.asarray(pad_rows(data[i:i + nrows], bucket))
+            tracing.counter("predict/chunks", 1)
+            dev = dispatch(dj)
+            if pending is not None:
+                fetch(*pending)
+            pending = (slice(i, i + nrows), nrows, dev)
+            if not pipeline:
+                fetch(*pending)
+                pending = None
+        if pending is not None:
+            fetch(*pending)
+
     def _predict_raw_matrix(self, data: np.ndarray,
                             num_iteration: int = -1,
                             pred_early_stop: bool = False,
@@ -1104,74 +1198,74 @@ class GBDT:
                             transform=None) -> np.ndarray:
         """Raw scores [num_data, num_tree_per_iteration] from raw features.
 
-        Trees are stacked to device ONCE; only the row axis is chunked
-        (large forests over >=500k-row single dispatches reproducibly
-        fault the relay-attached TPU worker)."""
-        import jax
-        import jax.numpy as jnp
+        Steady-state serving shape: the stacked forest comes from the
+        device-resident CompiledForest cache (stacked/transferred once
+        per model version, not per call), rows dispatch through the
+        bucket ladder, and the chunk loop is pipelined — see
+        _pipelined_chunks. Only the row axis is chunked (large forests
+        over >=500k-row single walk dispatches reproducibly fault the
+        relay-attached TPU worker)."""
         data = np.asarray(data, np.float32)
         self.finalize_training()
         n = data.shape[0]
         k = self.num_tree_per_iteration
-        total = len(self.models)
-        if num_iteration > 0:
-            total = min(total, num_iteration * k)
+        total = self._capped_total(num_iteration)
         out = np.zeros((k, n), np.float64)
         # margin-based prediction early stop (predictor.hpp:34-60: binary
         # and multiclass objectives only)
         use_es = (pred_early_stop and total > 0
                   and (k > 1 or (self.objective is not None
                                  and self.objective.name == "binary")))
+        cache = self._forest_cache()
         stacked_kt = None
         class_stacks = []
         if use_es:
-            from ..ops.predict import (predict_forest_raw_early_stop,
-                                       stack_trees_raw)
-            t_iters = total // k
-            stacked = stack_trees_raw(self.models[:t_iters * k])
-            # iteration-major [T*K, ...] -> [K, T, ...]
-            stacked_kt = jax.tree.map(
-                lambda a: jnp.swapaxes(
-                    a.reshape((t_iters, k) + a.shape[1:]), 0, 1), stacked)
+            stacked_kt = cache.early_stop_stacks(self.models, k, total // k)
         elif total > 0:
-            from ..ops.predict import stack_trees_matmul, stack_trees_raw
-            for cls in range(k):
-                class_trees = [self.models[i] for i in range(cls, total, k)]
-                # gather-free MXU path (ops/predict.MatmulForest),
-                # including categorical models via the one-hot category
-                # expansion; only over-budget forests take the walk
-                mf = stack_trees_matmul(class_trees) if class_trees else None
-                st = stack_trees_raw(class_trees) \
-                    if class_trees and mf is None else None
-                class_stacks.append((mf, st))
+            # gather-free MXU path (ops/predict.MatmulForest), including
+            # categorical models via the one-hot category expansion;
+            # only over-budget forests take the walk
+            class_stacks = cache.value_stacks(self.models, k, total)
 
-        c = self._PREDICT_ROW_CHUNK_MATMUL \
+        c = self._predict_chunk_rows(
+            self._PREDICT_ROW_CHUNK_MATMUL
             if (not use_es and class_stacks
-                and all(mf is not None for mf, _ in class_stacks)) \
-            else self._PREDICT_ROW_CHUNK
-        for i in range(0, n, c):
-            dj = jnp.asarray(data[i:i + c])
-            sl = slice(i, i + dj.shape[0])
+                and all(mf is not None for mf, _ in class_stacks))
+            else self._PREDICT_ROW_CHUNK)
+
+        def dispatch(dj):
             if use_es:
-                from ..ops.predict import predict_forest_raw_early_stop
-                out[:, sl] = np.asarray(predict_forest_raw_early_stop(
-                    stacked_kt, dj, float(pred_early_stop_margin),
-                    int(pred_early_stop_freq)), np.float64)
-            elif total > 0:
-                for cls, (mf, st) in enumerate(class_stacks):
-                    raw = _jit_forest_raw_matmul(mf, dj) if mf is not None \
-                        else (_jit_forest_raw(st, dj) if st is not None
-                              else None)
-                    if raw is None:
-                        continue
-                    if transform is not None:
-                        # output transform fused on device: ONE f32 fetch
-                        # instead of fetch-raw + re-upload + fetch-converted
-                        # (each blocking relay fetch of a 500k-row f64
-                        # vector measured ~1.3 s — more than the forest
-                        # compute itself)
-                        raw = transform(raw)
-                    out[cls, sl] = np.asarray(raw, np.float64)
+                # [K, bucket] device array, fetched as ONE D2H transfer
+                # (a per-class slice fetch would pay k blocking relay
+                # round trips per chunk)
+                return _jit_forest_es(stacked_kt, dj,
+                                      float(pred_early_stop_margin),
+                                      int(pred_early_stop_freq))
+            devs = []
+            for mf, st in class_stacks:
+                raw = _jit_forest_raw_matmul(mf, dj) if mf is not None \
+                    else (_jit_forest_raw(st, dj) if st is not None
+                          else None)
+                if raw is not None and transform is not None:
+                    # output transform fused on device: ONE f32 fetch
+                    # instead of fetch-raw + re-upload + fetch-converted
+                    # (each blocking relay fetch of a 500k-row f64
+                    # vector measured ~1.3 s — more than the forest
+                    # compute itself)
+                    raw = transform(raw)
+                devs.append(raw)
+            return devs
+
+        def fetch(sl, nrows, devs):
+            if not isinstance(devs, list):       # early-stop [K, bucket]
+                out[:, sl] = np.asarray(devs, np.float64)[:, :nrows]
+                return
+            for cls, dev in enumerate(devs):
+                if dev is not None:
+                    out[cls, sl] = np.asarray(dev, np.float64)[:nrows]
+
+        if use_es or class_stacks:
+            self._pipelined_chunks(data, c, dispatch, fetch)
         if transform is None:
             if self.average_output and total > 0:
                 out /= max(total // k, 1)
@@ -1187,30 +1281,34 @@ class GBDT:
         import jax.numpy as jnp
         self.finalize_training()
         if pred_leaf:
-            from ..ops.predict import (predict_forest_leaf_matmul,
-                                       predict_forest_leaf_raw,
-                                       stack_trees_matmul, stack_trees_raw)
             data = np.asarray(data, np.float32)
-            k = self.num_tree_per_iteration
-            total = len(self.models)
-            if num_iteration > 0:
-                total = min(total, num_iteration * k)
+            n = data.shape[0]
+            total = self._capped_total(num_iteration)
             if total == 0:
-                return np.zeros((data.shape[0], 0), np.int32)
-            mf = stack_trees_matmul(self.models[:total])
-            if mf is not None:
-                return np.asarray(predict_forest_leaf_matmul(
-                    mf, jnp.asarray(data)))
-            stacked = stack_trees_raw(self.models[:total])
-            return np.asarray(predict_forest_leaf_raw(
-                stacked, jnp.asarray(data)))
+                return np.zeros((n, 0), np.int32)
+            # same cache/cap/layout route as the value path (the two
+            # used to slice self.models and pick matmul-vs-walk
+            # independently)
+            mf, st = self._forest_cache().leaf_stacks(self.models, total)
+            c = self._predict_chunk_rows(
+                self._PREDICT_ROW_CHUNK_MATMUL if mf is not None
+                else self._PREDICT_ROW_CHUNK)
+            out = np.zeros((n, total), np.int32)
+
+            def dispatch(dj):
+                return _jit_forest_leaf_matmul(mf, dj) if mf is not None \
+                    else _jit_forest_leaf_raw(st, dj)
+
+            def fetch(sl, nrows, dev):
+                out[sl] = np.asarray(dev)[:nrows]
+
+            self._pipelined_chunks(data, c, dispatch, fetch)
+            return out
         if pred_contrib:
             from ..shap import predict_contrib
             return predict_contrib(self, np.asarray(data, np.float64), num_iteration)
         k = self.num_tree_per_iteration
-        total_cap = len(self.models)
-        if num_iteration > 0:
-            total_cap = min(total_cap, num_iteration * k)
+        total_cap = self._capped_total(num_iteration)
         if (not raw_score and self.objective is not None and k == 1
                 and not pred_early_stop and total_cap > 0):
             # single-class fast path: bias/averaging + the objective's
@@ -1338,6 +1436,7 @@ class GBDT:
         self.average_output = "average_output" in kv
         self.models = [Tree.from_string("\n".join(b)) for b in tree_blocks]
         self.iter_ = len(self.models) // max(self.num_tree_per_iteration, 1)
+        self._bump_model_version()
 
     # ------------------------------------------------------------------
     # checkpoint/resume (lightgbm_tpu/checkpoint.py drives this through
@@ -1390,6 +1489,8 @@ class GBDT:
             # such models never came from a checkpoint of this build)
             if tree.num_leaves > 1 and not tree.has_bin_metadata:
                 tree.attach_bin_metadata(self.train_data)
+        # metadata attach mutates the trees after the load's bump
+        self._bump_model_version()
         self.iter_ = int(state["iter"])
         self.shrinkage_rate = float(state["shrinkage_rate"])
         self.init_score_bias = float(state["init_score_bias"])

@@ -90,6 +90,7 @@ class RF(GBDT):
                     self._valid_score[vi] = self._valid_score[vi].at[cls].set(
                         (self._valid_score[vi][cls] * t_before + vadd) / (t_before + 1.0))
             self.models.append(tree)
+        self._bump_model_version()
         self.iter_ += 1
         if not could_split_any:
             for _ in range(k):

@@ -228,8 +228,10 @@ def run_predict(params: Dict, cfg: Config) -> None:
         log.fatal("No prediction data specified (data=...)")
     booster = Booster(model_file=cfg.io.input_model, params=dict(params))
     data, _ = load_data_file(cfg.data, has_header=cfg.io.has_header)
-    result = booster.predict(
-        data,
+    # serving front end (lightgbm_tpu/serving): device-resident compiled
+    # forest + bucketed, pipelined dispatch; its counters are the CLI's
+    # throughput report
+    predictor = booster.serving_predictor(
         num_iteration=cfg.io.num_iteration_predict,
         raw_score=cfg.io.is_predict_raw_score,
         pred_leaf=cfg.io.is_predict_leaf_index,
@@ -237,6 +239,14 @@ def run_predict(params: Dict, cfg: Config) -> None:
         pred_early_stop=cfg.io.pred_early_stop,
         pred_early_stop_freq=cfg.io.pred_early_stop_freq,
         pred_early_stop_margin=cfg.io.pred_early_stop_margin)
+    result = predictor.predict(data)
+    stats = predictor.stats()
+    if stats.get("mean_latency_ms"):
+        secs = stats["mean_latency_ms"] / 1e3
+        log.info("Predicted %d rows in %.3fs (%.0f rows/s, %d forest "
+                 "restack(s))", data.shape[0], secs,
+                 data.shape[0] / max(secs, 1e-9),
+                 stats.get("stack_restacks", 0))
     result = np.atleast_1d(np.asarray(result))
     with open(cfg.io.output_result, "w") as fh:
         # vectorized formatting (np.char.mod runs the %-format in C): a

@@ -149,6 +149,30 @@ class IOConfig:
     pred_early_stop: bool = False
     pred_early_stop_freq: int = 10
     pred_early_stop_margin: float = 10.0
+    # serving-grade prediction engine (lightgbm_tpu/serving/ +
+    # boosting/gbdt.py): device-resident compiled forest cache with
+    # model-version invalidation — trees are stacked/transferred once
+    # per model version instead of per predict call
+    tpu_predict_cache: bool = True
+    # smallest row bucket of the power-of-two dispatch ladder; batch
+    # sizes pad up the ladder so arbitrary sizes hit a handful of
+    # compiled programs (<= 0 disables bucketing: every distinct batch
+    # size compiles its own program, the seed behavior)
+    tpu_predict_bucket_min: int = 16
+    # rows per predict dispatch chunk (0 = auto: 512k matmul / 128k walk
+    # — large forests over >=500k-row walk dispatches fault the
+    # relay-attached TPU worker, see boosting/gbdt.py)
+    tpu_predict_chunk: int = 0
+    # double-buffered chunk loop: dispatch chunk k+1 before fetching
+    # chunk k so H2D/compute/D2H overlap instead of serializing
+    tpu_predict_pipeline: bool = True
+    # Predictor.warmup() compiles bucket programs up to this many rows
+    tpu_predict_warmup_rows: int = 4096
+    # Predictor.submit() coalesces up to this many concurrent single-row
+    # requests into one device dispatch (0 = no micro-batching)
+    tpu_predict_micro_batch: int = 32
+    # how long submit() waits for co-arriving rows before dispatching
+    tpu_predict_micro_batch_window_ms: float = 0.5
     use_missing: bool = True
     zero_as_missing: bool = False
     sparse_threshold: float = 0.8

@@ -247,6 +247,9 @@ def _continue_from(booster: Booster, init_booster: Booster, train_set: Dataset):
     init_inner = init_booster._inner
     inner.models = copy.deepcopy(init_inner.models)
     inner.iter_ = init_inner.iter_
+    # the ensemble was swapped wholesale — stale compiled forests must
+    # not survive into the continued run's predictions
+    inner._bump_model_version()
     # carry over best-iteration / eval history when the init model has
     # them (a Booster handed over from a previous train() call): the
     # continued run starts from the loaded run's record instead of

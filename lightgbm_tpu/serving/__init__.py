@@ -1,0 +1,15 @@
+"""Serving-grade prediction engine.
+
+`CompiledForest` (forest.py) keeps the stacked/padded forest device-
+resident across `predict` calls with model-version invalidation;
+`Predictor` (predictor.py) is the request-facing front end: bucket-
+ladder warmup, a low-latency small-batch path, optional micro-batching
+of concurrent requests, and throughput/latency/cache counters. The
+reference analogue is `Predictor` (predictor.hpp:24-205), whose
+prediction closures are likewise built once per booster, not per call.
+"""
+from .forest import CompiledForest, bucket_ladder, bucket_rows, pad_rows
+from .predictor import Predictor
+
+__all__ = ["CompiledForest", "Predictor", "bucket_ladder", "bucket_rows",
+           "pad_rows"]

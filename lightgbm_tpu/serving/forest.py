@@ -1,0 +1,181 @@
+"""Device-resident compiled forest cache + shape-bucketed dispatch plan.
+
+The reference builds its prediction closures once per booster
+(`Predictor::Predictor`, predictor.hpp:24-78: the `predict_fun_`
+lambdas capture the iterated-over trees) and GBDT inference
+accelerators keep the packed forest resident across requests
+(arXiv:2011.02022). The TPU analogue: stacking/padding/transferring
+the host `Tree` objects into a `MatmulForest`/`DeviceTree` is O(forest)
+host work and an H2D transfer of the whole ensemble — paying it per
+`predict` call makes steady-state serving host-bound. `CompiledForest`
+caches every stacked layout keyed by `(layout, trees-used, model
+version)`; the monotonically increasing model version is bumped by the
+owning `GBDT` on EVERY ensemble mutation (tree append, rollback,
+continued training, checkpoint restore, model load, DART
+re-normalization), so a stale stack is structurally impossible: old
+versions can never be looked up again.
+
+Shape buckets: `jax` compiles one program per input shape. Serving
+traffic has arbitrary batch sizes, so the row axis is padded up a
+power-of-two ladder (`bucket_rows`) — arbitrary sizes then hit a
+handful of compiled programs instead of retracing per shape. Every
+prediction kernel in ops/predict.py is row-independent (per-row
+gathers / per-row matmul contractions; the traversal while_loops only
+extend their trip count), so padded rows change nothing for the real
+rows: predictions stay bit-identical and the padding is sliced off
+after the fetch.
+"""
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+# stacked layouts kept per model version: one per distinct
+# (num_iteration cap, layout kind) seen — enough for a serving process
+# that predicts at a couple of caps without letting an iteration sweep
+# (e.g. a learning-curve plot) pin every prefix of the forest on device
+_MAX_ENTRIES = 8
+
+# default power-of-two ladder floor: a single row pads to 16, which
+# costs nothing on a 128-lane machine and keeps the ladder short
+DEFAULT_BUCKET_MIN = 16
+
+
+def bucket_rows(n: int, bucket_min: int = DEFAULT_BUCKET_MIN,
+                cap: int = 1 << 19) -> int:
+    """Smallest ladder size >= n: power-of-two steps from bucket_min up
+    to cap (chunking splits anything larger). bucket_min <= 0 disables
+    bucketing (every size compiles its own program — the seed
+    behavior)."""
+    if bucket_min <= 0 or n >= cap:
+        return min(n, cap) if n > 0 else n
+    b = max(1, int(bucket_min))
+    while b < n:
+        b <<= 1
+    return min(b, cap)
+
+
+def bucket_ladder(bucket_min: int, cap: int) -> List[int]:
+    """All bucket sizes warmup() should compile, smallest first. The
+    top entry rounds cap UP to the next ladder step — real requests
+    dispatch through bucket_rows, which only ever produces power-of-two
+    multiples of bucket_min, so a raw non-power-of-two cap would warm a
+    program no request ever uses."""
+    if bucket_min <= 0:
+        return []
+    out = []
+    b = max(1, int(bucket_min))
+    while b < cap:
+        out.append(b)
+        b <<= 1
+    out.append(b)
+    return out
+
+
+def pad_rows(arr: np.ndarray, size: int) -> np.ndarray:
+    """Zero-pad the row axis to `size` (no-op when already there)."""
+    if arr.shape[0] >= size:
+        return arr
+    pad = np.zeros((size - arr.shape[0],) + arr.shape[1:], arr.dtype)
+    return np.concatenate([arr, pad], axis=0)
+
+
+class CompiledForest:
+    """Per-booster cache of device-resident stacked forests.
+
+    Owned by `GBDT`; every ensemble mutation calls `invalidate()`,
+    which bumps the model version and drops all entries. Lookups key on
+    the CURRENT version, so even an entry that somehow survived a clear
+    could never be returned for a newer model. `enabled=False` (the
+    `tpu_predict_cache=false` escape hatch) makes every lookup rebuild,
+    reproducing the per-call-restack seed behavior for A/B timing."""
+
+    def __init__(self):
+        self._version = 0
+        self._cache: "OrderedDict[Tuple, Any]" = OrderedDict()
+        self.enabled = True
+        # the Predictor serves concurrent requests (micro-batcher thread
+        # + caller threads); the lock covers lookup AND build so two
+        # simultaneous misses cannot stack/transfer the forest twice
+        # (which would break the one-restack-per-version invariant)
+        self._lock = threading.RLock()
+        self.stats: Dict[str, int] = {
+            "restacks": 0, "hits": 0, "invalidations": 0}
+
+    @property
+    def version(self) -> int:
+        return self._version
+
+    def invalidate(self) -> None:
+        with self._lock:
+            self._version += 1
+            if self._cache:
+                self.stats["invalidations"] += 1
+            self._cache.clear()
+
+    def _get(self, key: Tuple, build: Callable[[], Any]) -> Any:
+        from .. import tracing
+        with self._lock:
+            key = key + (self._version,)
+            if self.enabled:
+                hit = self._cache.get(key)
+                if hit is not None:
+                    self._cache.move_to_end(key)
+                    self.stats["hits"] += 1
+                    tracing.counter("predict/stack_cache_hit", 1)
+                    return hit
+            value = build()
+            self.stats["restacks"] += 1
+            tracing.counter("predict/restack", 1)
+            if self.enabled:
+                self._cache[key] = value
+                while len(self._cache) > _MAX_ENTRIES:
+                    self._cache.popitem(last=False)
+            return value
+
+    # ------------------------------------------------------------------
+    # stacked layouts (each build counts as ONE restack regardless of
+    # class count — the unit the invalidation tests probe)
+    def value_stacks(self, models, k: int, total: int):
+        """Per-class [(MatmulForest|None, DeviceTree|None)] for raw-score
+        prediction (the layout choice of GBDT._predict_raw_matrix:
+        gather-free MXU path when the path tensor fits, walk
+        otherwise)."""
+        def build():
+            from ..ops.predict import stack_trees_matmul, stack_trees_raw
+            stacks = []
+            for cls in range(k):
+                class_trees = [models[i] for i in range(cls, total, k)]
+                mf = stack_trees_matmul(class_trees) if class_trees else None
+                st = stack_trees_raw(class_trees) \
+                    if class_trees and mf is None else None
+                stacks.append((mf, st))
+            return stacks
+        return self._get(("value", total, k), build)
+
+    def leaf_stacks(self, models, total: int):
+        """(MatmulForest|None, DeviceTree|None) over ALL trees for
+        pred_leaf — the same cap/layout choice as the value path, so
+        both routes share one stacking implementation."""
+        def build():
+            from ..ops.predict import stack_trees_matmul, stack_trees_raw
+            mf = stack_trees_matmul(models[:total])
+            st = stack_trees_raw(models[:total]) if mf is None else None
+            return (mf, st)
+        return self._get(("leaf", total), build)
+
+    def early_stop_stacks(self, models, k: int, t_iters: int):
+        """[K, T, ...] DeviceTree for margin-based prediction early stop
+        (ops/predict.predict_forest_raw_early_stop)."""
+        def build():
+            import jax
+            import jax.numpy as jnp
+            from ..ops.predict import stack_trees_raw
+            stacked = stack_trees_raw(models[:t_iters * k])
+            return jax.tree.map(
+                lambda a: jnp.swapaxes(
+                    a.reshape((t_iters, k) + a.shape[1:]), 0, 1), stacked)
+        return self._get(("early_stop", t_iters, k), build)

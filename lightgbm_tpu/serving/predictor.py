@@ -1,0 +1,235 @@
+"""Serving front end: warmup, low-latency small-batch path, optional
+micro-batching, and throughput/latency counters.
+
+The reference serves predictions through a per-model `Predictor`
+(predictor.hpp:24-205) whose closures are built once and reused per
+request; this is its TPU-shaped counterpart for the ROADMAP's
+"heavy traffic from millions of users" north star. The heavy lifting —
+device-resident stacked forests, shape-bucketed dispatch, the pipelined
+chunk loop — lives in `GBDT` + `serving.forest.CompiledForest`; this
+layer adds what a serving process needs around it:
+
+- `warmup()` compiles the whole bucket ladder up front so the first
+  real request never pays a trace (and the stacking happens exactly
+  once, before traffic arrives);
+- `predict()` / `predict_one()` time every request into a latency ring
+  and tracing counters (`serving/requests`, `serving/rows`), the same
+  surface as the training-side counters;
+- `submit()` optionally coalesces concurrent single-row requests into
+  one device dispatch (micro-batching): rows arriving within
+  `tpu_predict_micro_batch_window_ms` of each other ride one bucketed
+  program instead of one dispatch each.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .. import log, tracing
+from .forest import bucket_ladder
+
+# latency ring size: enough for stable percentiles without unbounded
+# growth in a long-lived serving process
+_LATENCY_WINDOW = 2048
+
+
+class Predictor:
+    """Reference: class Predictor, predictor.hpp:24-205 — built once per
+    booster, reused per request. Accepts a `basic.Booster` or a bare
+    `boosting.GBDT`; per-request overrides ride on `predict(**kw)`."""
+
+    def __init__(self, booster, num_iteration: int = -1,
+                 raw_score: bool = False, pred_leaf: bool = False,
+                 pred_contrib: bool = False, pred_early_stop: bool = False,
+                 pred_early_stop_freq: int = 10,
+                 pred_early_stop_margin: float = 10.0):
+        self._gbdt = getattr(booster, "_inner", booster)
+        self._kwargs = dict(
+            num_iteration=num_iteration, raw_score=raw_score,
+            pred_leaf=pred_leaf, pred_contrib=pred_contrib,
+            pred_early_stop=pred_early_stop,
+            pred_early_stop_freq=pred_early_stop_freq,
+            pred_early_stop_margin=pred_early_stop_margin)
+        io = self._gbdt.config.io
+        self._micro_batch = max(0, int(io.tpu_predict_micro_batch))
+        self._window_s = max(0.0, float(
+            io.tpu_predict_micro_batch_window_ms)) / 1e3
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._queue: List = []
+        self._batcher: Optional[threading.Thread] = None
+        self._closed = False
+        self._latencies = collections.deque(maxlen=_LATENCY_WINDOW)
+        self._counts = {"requests": 0, "rows": 0,
+                        "micro_batches": 0, "micro_rows": 0}
+        self._warmup_seconds: Optional[float] = None
+        self._warmup_buckets: List[int] = []
+
+    # ------------------------------------------------------------------
+    def num_features(self) -> int:
+        return self._gbdt.max_feature_idx + 1
+
+    def warmup(self, max_rows: Optional[int] = None) -> Dict[str, Any]:
+        """Compile every bucket program up to `max_rows` (default
+        `tpu_predict_warmup_rows`) and stack the forest once, so the
+        first real request is pure device compute. Warmup traffic is
+        NOT counted in the request/latency stats."""
+        io = self._gbdt.config.io
+        cap = int(max_rows if max_rows is not None
+                  else io.tpu_predict_warmup_rows)
+        ladder = bucket_ladder(int(io.tpu_predict_bucket_min), max(1, cap))
+        f = self.num_features()
+        t0 = time.perf_counter()
+        for rows in ladder:
+            self._predict_inner(np.zeros((rows, f), np.float32))
+        self._warmup_seconds = time.perf_counter() - t0
+        self._warmup_buckets = ladder
+        tracing.counter("serving/warmup_buckets", len(ladder))
+        log.debug("Predictor warmup: %d bucket programs in %.3fs",
+                  len(ladder), self._warmup_seconds)
+        return {"buckets": ladder, "seconds": self._warmup_seconds}
+
+    # ------------------------------------------------------------------
+    def _predict_inner(self, arr: np.ndarray, **overrides):
+        kw = dict(self._kwargs)
+        kw.update(overrides)
+        return self._gbdt.predict(arr, **kw)
+
+    def predict(self, data, **overrides):
+        """Timed predict over a [N, F] batch (rows also accepted as a
+        single 1-D row, returned as a 1-row result — use predict_one()
+        for the squeezed scalar path)."""
+        kw = dict(self._kwargs)
+        kw.update(overrides)
+        # TreeSHAP walks raw f64 thresholds (shap._decision_vec): an f32
+        # cast here can flip a hot/cold path for values straddling an
+        # f32-rounded threshold, so contrib keeps the caller's dtype
+        arr = np.asarray(data) if kw.get("pred_contrib") \
+            else np.asarray(data, np.float32)
+        if arr.ndim == 1:
+            arr = arr.reshape(1, -1)
+        t0 = time.perf_counter()
+        out = self._gbdt.predict(arr, **kw)
+        dt = time.perf_counter() - t0
+        with self._lock:
+            self._counts["requests"] += 1
+            self._counts["rows"] += int(arr.shape[0])
+            self._latencies.append(dt)
+        tracing.counter("serving/requests", 1)
+        tracing.counter("serving/rows", int(arr.shape[0]))
+        return out
+
+    def predict_one(self, row, **overrides):
+        """Single-row fast path: pads to the smallest bucket on one
+        resident compiled program; returns the row's prediction with
+        the batch axis squeezed."""
+        return self.predict(np.asarray(row, np.float32).reshape(1, -1),
+                            **overrides)[0]
+
+    # ------------------------------------------------------------------
+    # micro-batching: coalesce concurrent single-row requests
+    def submit(self, row) -> Future:
+        """Enqueue one row; resolves to its prediction. With
+        `tpu_predict_micro_batch` 0 this degenerates to a synchronous
+        predict_one; otherwise rows arriving within the window share
+        one device dispatch."""
+        arr = np.asarray(row, np.float32).reshape(-1)
+        fut: Future = Future()
+        if self._micro_batch <= 0:
+            try:
+                fut.set_result(self.predict_one(arr))
+            except Exception as exc:  # surface through the future
+                fut.set_exception(exc)
+            return fut
+        with self._cv:
+            if self._closed:
+                raise log.LightGBMError("Predictor is closed")
+            if self._batcher is None:
+                self._batcher = threading.Thread(
+                    target=self._batch_loop, name="lgbm-tpu-microbatch",
+                    daemon=True)
+                self._batcher.start()
+            self._queue.append((arr, fut))
+            self._cv.notify()
+        return fut
+
+    def _batch_loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._queue and not self._closed:
+                    self._cv.wait()
+                if self._closed and not self._queue:
+                    return
+                # collect up to micro_batch rows arriving within the window
+                deadline = time.perf_counter() + self._window_s
+                while len(self._queue) < self._micro_batch:
+                    remaining = deadline - time.perf_counter()
+                    if remaining <= 0 or self._closed:
+                        break
+                    self._cv.wait(timeout=remaining)
+                batch = self._queue[:self._micro_batch]
+                del self._queue[:len(batch)]
+            # claim each future; a client may have cancel()ed while its
+            # row sat in the window (request-timeout pattern) — resolving
+            # a cancelled future raises and would kill this thread
+            live = [(r, f) for r, f in batch
+                    if f.set_running_or_notify_cancel()]
+            if not live:
+                continue
+            rows = np.stack([r for r, _ in live])
+            try:
+                res = self.predict(rows)
+            except Exception as exc:
+                for _, fut in live:
+                    fut.set_exception(exc)
+                continue
+            with self._lock:
+                self._counts["micro_batches"] += 1
+                self._counts["micro_rows"] += len(live)
+            tracing.counter("serving/micro_batches", 1)
+            for i, (_, fut) in enumerate(live):
+                fut.set_result(res[i])
+
+    def close(self) -> None:
+        """Stop the micro-batcher (pending requests still complete)."""
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        if self._batcher is not None:
+            self._batcher.join(timeout=5.0)
+            self._batcher = None
+
+    # ------------------------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        """Counters in the same spirit as tracing's training counters:
+        request/row totals, latency percentiles over the recent window,
+        service throughput, and the forest cache's restack economics."""
+        with self._lock:
+            lat = sorted(self._latencies)
+            counts = dict(self._counts)
+        out: Dict[str, Any] = dict(counts)
+        out["model_version"] = int(self._gbdt._compiled_forest.version)
+        out.update({f"stack_{k}": int(v) for k, v in
+                    self._gbdt._compiled_forest.stats.items()})
+        out["warmup_seconds"] = self._warmup_seconds
+        out["warmup_buckets"] = list(self._warmup_buckets)
+        if lat:
+            def pct(p):
+                return lat[min(len(lat) - 1, int(p * len(lat)))]
+            total = sum(lat)
+            out["p50_latency_ms"] = round(pct(0.50) * 1e3, 4)
+            out["p95_latency_ms"] = round(pct(0.95) * 1e3, 4)
+            out["p99_latency_ms"] = round(pct(0.99) * 1e3, 4)
+            out["mean_latency_ms"] = round(total / len(lat) * 1e3, 4)
+            if total > 0:
+                # rows in the ring window / time spent serving them
+                rows_window = counts["rows"] if len(lat) == counts["requests"] \
+                    else None
+                if rows_window is not None:
+                    out["rows_per_second"] = round(rows_window / total, 2)
+        return out

@@ -210,11 +210,20 @@ class LGBMModel(_SKBase):
 
     def predict(self, X, raw_score: bool = False, num_iteration: int = -1,
                 pred_leaf: bool = False, pred_contrib: bool = False):
+        # routed through the booster's shared serving Predictor
+        # (lightgbm_tpu/serving): device-resident compiled forest,
+        # bucketed dispatch, request counters
         if self._Booster is None:
             raise LightGBMError("Estimator not fitted, call fit before predict")
         return self._Booster.predict(X, num_iteration=num_iteration,
                                      raw_score=raw_score, pred_leaf=pred_leaf,
                                      pred_contrib=pred_contrib)
+
+    def serving_predictor(self, **kwargs):
+        """Serving front end over the fitted booster (warmup over the
+        bucket ladder, micro-batching, latency/throughput counters) —
+        see `lightgbm_tpu.serving.Predictor`."""
+        return self.booster_.serving_predictor(**kwargs)
 
     @property
     def booster_(self) -> Booster:

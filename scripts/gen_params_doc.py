@@ -73,6 +73,28 @@ DESCRIPTIONS = {
     "pred_early_stop": "stop accumulating trees once the margin is safe",
     "pred_early_stop_freq": "check the margin every N iterations",
     "pred_early_stop_margin": "margin threshold for prediction early stop",
+    "tpu_predict_cache": "device-resident compiled forest cache: trees "
+                         "are stacked/padded/transferred once per model "
+                         "version instead of per predict call (false = "
+                         "per-call restack, for A/B timing)",
+    "tpu_predict_bucket_min": "smallest row bucket of the power-of-two "
+                              "predict dispatch ladder; batches pad up "
+                              "the ladder so arbitrary sizes reuse a "
+                              "handful of compiled programs (<= 0 "
+                              "disables bucketing)",
+    "tpu_predict_chunk": "rows per predict dispatch chunk (0 = auto: "
+                         "512k matmul / 128k walk)",
+    "tpu_predict_pipeline": "double-buffered predict chunk loop: "
+                            "dispatch chunk k+1 before fetching chunk "
+                            "k so transfer and compute overlap",
+    "tpu_predict_warmup_rows": "Predictor.warmup() compiles bucket "
+                               "programs up to this many rows",
+    "tpu_predict_micro_batch": "max concurrent single-row requests "
+                               "Predictor.submit() coalesces into one "
+                               "device dispatch (0 = no micro-batching)",
+    "tpu_predict_micro_batch_window_ms": "how long submit() waits for "
+                                         "co-arriving rows before "
+                                         "dispatching the micro-batch",
     "use_missing": "handle NaN/missing specially (false = plain values)",
     "zero_as_missing": "treat zeros as missing (sparse semantics)",
     "sparse_threshold": "column sparsity above which EFB treats the "

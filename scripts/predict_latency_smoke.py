@@ -1,0 +1,89 @@
+"""Serving fast-path regression gate: repeated single-row predict must
+trigger ZERO recompilations and ZERO forest restacks after warmup.
+
+Trains a tiny model, warms the serving Predictor over its bucket
+ladder, then fires repeated single-row predicts while counting jax
+backend compilations (via jax.monitoring compile events) and
+CompiledForest restacks. Any nonzero count means the low-latency path
+silently regressed to retracing/restacking — the exact failure mode
+the shape-bucketed dispatch and the model-version cache exist to
+prevent.
+
+Usage: python scripts/predict_latency_smoke.py
+Exits nonzero on regression; prints one machine-readable JSON line.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+
+def main() -> int:
+    import jax.monitoring
+    import lightgbm_tpu as lgb
+
+    compile_events = []
+    jax.monitoring.register_event_listener(
+        lambda name, **kw: compile_events.append(name)
+        if "compil" in name else None)
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(2000, 10).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] * X[:, 2] > 0).astype(np.float32)
+    params = {"objective": "binary", "verbose": -1, "num_leaves": 15,
+              "min_data_in_leaf": 5}
+    ds = lgb.Dataset(X, y, params=dict(params))
+    booster = lgb.train(dict(params), ds, num_boost_round=20,
+                        verbose_eval=False)
+
+    predictor = booster.serving_predictor()
+    warm = predictor.warmup(max_rows=64)
+    # one settling request per path the loop exercises
+    predictor.predict_one(X[0])
+    predictor.predict(X[:3])
+
+    stats0 = predictor.stats()
+    compile_events.clear()
+    reps = int(os.environ.get("SMOKE_REPS", 50))
+    t0 = time.perf_counter()
+    for i in range(reps):
+        predictor.predict_one(X[i % len(X)])
+        predictor.predict(X[i % 100:i % 100 + 3])
+    wall = time.perf_counter() - t0
+    stats1 = predictor.stats()
+
+    compiles = len(compile_events)
+    restacks = stats1["stack_restacks"] - stats0["stack_restacks"]
+    ok = compiles == 0 and restacks == 0
+    print(json.dumps({
+        "metric": "predict_latency_smoke",
+        "value": 1 if ok else 0,
+        "unit": "pass",
+        "detail": {
+            "reps": reps,
+            "compiles_after_warmup": compiles,
+            "restacks_after_warmup": int(restacks),
+            "warmup_buckets": warm["buckets"],
+            "warmup_seconds": round(warm["seconds"], 3),
+            "p50_latency_ms": stats1.get("p50_latency_ms"),
+            "steady_wall_seconds": round(wall, 3),
+        },
+    }), flush=True)
+    if not ok:
+        print("FAIL: fast path retraced (%d compiles) or restacked (%d) "
+              "after warmup" % (compiles, restacks), file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

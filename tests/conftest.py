@@ -27,13 +27,24 @@ if os.environ.get("LGBM_TPU_TEST_PLATFORM", "cpu") == "cpu":
     assert len(jax.devices()) == 8, "tests expect 8 virtual CPU devices"
 
 
+def pytest_configure(config):
+    # tier-1 CI deselects these (`-m 'not slow'`): long benchmark-grade
+    # runs (bulk predict throughput, 500-tree latency economics)
+    config.addinivalue_line(
+        "markers", "slow: long benchmark-grade runs excluded from tier-1")
+
+
 def pytest_collection_modifyitems(config, items):
     """Run the robustness suites (checkpoint/resume, fault injection,
     kill-and-resume cycles) LAST: tier-1 CI runs under a fixed
     wall-clock budget, and the broad regression coverage must not be
     displaced past the cutoff by training-heavy robustness cycles."""
     late_modules = {"tests.test_checkpoint", "tests.test_faults",
-                    "test_checkpoint", "test_faults"}
+                    "test_checkpoint", "test_faults",
+                    # new serving coverage rides after the pre-existing
+                    # broad regression suites: if the budget cuts
+                    # anything, it cuts the newest tests first
+                    "tests.test_serving", "test_serving"}
     late_tests = {
         "test_cli_checkpoint_kill_and_resume",
         "test_continued_training_binned_replay_exact",
