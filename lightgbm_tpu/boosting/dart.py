@@ -128,6 +128,10 @@ class DART(GBDT):
         for i in self.drop_index:
             self._tree_contribution(i, -1.0, on_valid=False)
         kdrop = len(self.drop_index)
+        # drop activity in the run log / counters: a DART run whose
+        # ledger drifted is diagnosed from dropped-per-iteration deltas
+        from .. import tracing
+        tracing.counter("boosting/dart_dropped_trees", kdrop)
         if not cfg.xgboost_dart_mode:
             self.shrinkage_rate = cfg.learning_rate / (1.0 + kdrop)
         else:

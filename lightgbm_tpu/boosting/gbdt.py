@@ -501,6 +501,19 @@ class GBDT:
                  "compact=%s@%.2f batch_k=%d table_mult=%d chunk=%d",
                  g_cnt, self._max_bins, wide, subtract, compact,
                  compact_frac, batch_k, table_mult, self._chunk)
+        # execution-schedule summary for the telemetry run-log header
+        # (telemetry/runlog.py): the knobs that explain this run's pass
+        # economics, host-readable without re-deriving the auto-selection
+        self._schedule_info = {
+            "tree_learner": self._tree_learner_kind,
+            "num_shards": int(ndev), "num_processes": int(nproc),
+            "groups": int(g_cnt), "max_bin": int(self._max_bins),
+            "wide": bool(wide), "subtract": bool(subtract),
+            "compact": bool(compact), "compact_fraction": compact_frac,
+            "batch_k": int(batch_k), "table_mult": int(table_mult),
+            "chunk": int(self._chunk), "rows": int(n),
+            "rows_padded": int(n_pad),
+        }
         self._grower_cfg = GrowerConfig(
             num_leaves=self.config.tree.num_leaves,
             max_bins=self._max_bins,
@@ -569,6 +582,11 @@ class GBDT:
         self._fmeta = {k: jnp.asarray(v) for k, v in fm.items()}
 
         self._feature_rng = np.random.RandomState(self.config.tree.feature_fraction_seed)
+
+        # final grower schedule (group widths may have been re-planned by
+        # the feature-parallel padding above) for the run-log header
+        from ..learner.grow import schedule_summary
+        self._schedule_info["grower"] = schedule_summary(self._grower_cfg)
 
         # boost from average (gbdt.cpp:358-378): the score bump happens at
         # init; the bias itself is folded into the first trained tree via
@@ -644,6 +662,8 @@ class GBDT:
             self._bag_cache = _bagging_mask_device(
                 self.config.boosting.bagging_seed, iter_idx // freq,
                 self._n, self._n_pad, bf)
+            from .. import tracing
+            tracing.counter("boosting/bagging_refresh", 1)
         return self._bag_cache
 
     def _row_weight_from_bag(self, bag):

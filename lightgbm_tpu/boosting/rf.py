@@ -69,14 +69,19 @@ class RF(GBDT):
         bag = self._bagging_weights(self.iter_, grad, hess)
         row_weight = self._row_weight_from_bag(bag)
 
+        from .. import tracing
         from ..tree import Tree
         from ..ops.predict import predict_value_binned
         could_split_any = False
         t_before = float(self.iter_)
         for cls in range(k):
             mask = self._feature_mask()
-            state = self._grow(grad[cls], hess[cls], row_weight, mask)
-            tree = Tree.from_grower_state(state, self.train_data)
+            # phase spans match the base class's so RF iterations show
+            # up under the same tree/grow..tree/extract accounting
+            with tracing.phase("tree/grow"):
+                state = self._grow(grad[cls], hess[cls], row_weight, mask)
+            with tracing.phase("tree/extract"):
+                tree = Tree.from_grower_state(state, self.train_data)
             if tree.num_leaves > 1:
                 could_split_any = True
                 # running average: score_{t+1} = (score_t * t + tree) / (t+1)

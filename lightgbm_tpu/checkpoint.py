@@ -54,6 +54,9 @@ _HEADER_RE = re.compile(
 # legitimately differ between the original and the resumed invocation)
 _FINGERPRINT_EXCLUDE = {
     "tpu_checkpoint_dir", "tpu_checkpoint_interval", "tpu_checkpoint_keep",
+    # observability never changes the training trajectory: a resumed run
+    # may add/move/drop its telemetry sinks freely
+    "tpu_telemetry_dir", "tpu_telemetry", "tpu_telemetry_prometheus",
     "output_model", "output_result", "input_model", "convert_model",
     "config_file", "machine_list_file", "snapshot_freq", "verbose",
     "metric_freq", "num_iterations", "num_threads", "task",

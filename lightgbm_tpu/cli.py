@@ -182,6 +182,12 @@ def run_train(params: Dict, cfg: Config) -> None:
         valid_sets.append(_build_dataset(vpath, params, cfg, reference=train_set))
         valid_names.append(os.path.basename(vpath))
 
+    if cfg.io.tpu_telemetry_dir:
+        # engine.train opens the run log; named here so operators know
+        # where the trail will be before the (possibly hours-long) run
+        log.info("Telemetry armed: JSONL run log + Prometheus dump under "
+                 "%s (scripts/telemetry_report.py renders it)",
+                 cfg.io.tpu_telemetry_dir)
     if cfg.io.tpu_checkpoint_dir:
         # engine.train resumes from / writes to this directory; surfaced
         # here so operators see preemption tolerance is armed before the
@@ -226,6 +232,13 @@ def run_predict(params: Dict, cfg: Config) -> None:
         log.fatal("No input model specified (input_model=...)")
     if not cfg.data:
         log.fatal("No prediction data specified (data=...)")
+    if cfg.io.tpu_telemetry_dir:
+        # serving-side observability: collect predict/serving counters +
+        # latency histograms for this invocation and dump them as
+        # Prometheus text exposition on exit
+        from . import telemetry
+        telemetry.enable(True)
+        telemetry.install_observer()
     booster = Booster(model_file=cfg.io.input_model, params=dict(params))
     data, _ = load_data_file(cfg.data, has_header=cfg.io.has_header)
     # serving front end (lightgbm_tpu/serving): device-resident compiled
@@ -257,6 +270,20 @@ def run_predict(params: Dict, cfg: Config) -> None:
             rows = np.char.mod("%.9g", result)
             fh.write("\n".join("\t".join(r) for r in rows) + "\n")
     log.info("Finished prediction, results saved to %s", cfg.io.output_result)
+    if cfg.io.tpu_telemetry_dir and cfg.io.tpu_telemetry_prometheus:
+        from .telemetry import export
+        rank = 0
+        try:
+            import jax
+            rank = jax.process_index()
+        except Exception:
+            pass
+        path = os.path.join(cfg.io.tpu_telemetry_dir,
+                            f"metrics_predict_r{rank}.prom")
+        os.makedirs(cfg.io.tpu_telemetry_dir, exist_ok=True)
+        export.write_prometheus(path, extra_labels={"rank": str(rank),
+                                                    "task": "predict"})
+        log.info("Serving metrics written to %s", path)
 
 
 def run_convert_model(params: Dict, cfg: Config) -> None:

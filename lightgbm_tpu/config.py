@@ -143,6 +143,19 @@ class IOConfig:
     tpu_checkpoint_dir: str = ""
     tpu_checkpoint_interval: int = 10
     tpu_checkpoint_keep: int = 3
+    # unified telemetry (lightgbm_tpu/telemetry/): when a directory is
+    # set, training opens a structured JSONL run log there (header +
+    # one record per iteration + events + summary, appended so a
+    # preempted run's trail survives) and dumps the metrics registry as
+    # Prometheus text exposition at end of run (one file per rank,
+    # cross-rank aggregate on rank 0)
+    tpu_telemetry_dir: str = ""
+    # collect span timers / counters / compile events WITHOUT a run log
+    # (exit dump only — the LGBM_TPU_TIMETAG behavior, config-exposed)
+    tpu_telemetry: bool = False
+    # write the end-of-run Prometheus exposition files (disable to keep
+    # only the JSONL run log in tpu_telemetry_dir)
+    tpu_telemetry_prometheus: bool = True
     is_predict_raw_score: bool = False
     is_predict_leaf_index: bool = False
     is_predict_contrib: bool = False

@@ -17,6 +17,7 @@ import numpy as np
 from . import callback as callback_mod
 from . import checkpoint as checkpoint_mod
 from . import log
+from . import telemetry as telemetry_mod
 from .basic import Booster, Dataset, LightGBMError
 from .config import key_alias_transform
 from .testing import faults
@@ -88,7 +89,15 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
     # preemption-tolerant checkpointing (lightgbm_tpu/checkpoint.py):
     # resume from the newest valid snapshot, then snapshot every
     # tpu_checkpoint_interval iterations through the checkpoint callback
-    start_iter = _setup_checkpointing(booster, callbacks)
+    recorder_ref: Dict[str, Any] = {"r": None}
+    start_iter = _setup_checkpointing(booster, callbacks, recorder_ref)
+    # observability (lightgbm_tpu/telemetry/): armed AFTER a possible
+    # resume so the run-log header names the true start iteration; the
+    # recorder is None when telemetry is off and costs nothing then
+    recorder = telemetry_mod.start_run(booster._inner, params)
+    recorder_ref["r"] = recorder
+    if recorder is not None and start_iter > 0:
+        recorder.event("resume", iteration=start_iter)
 
     callbacks_before = [cb for cb in callbacks if getattr(cb, "before_iteration", False)]
     callbacks_after = [cb for cb in callbacks if not getattr(cb, "before_iteration", False)]
@@ -110,6 +119,9 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
                                             evaluation_result_list=None))
             stop = booster.update(fobj=fobj)
             if stop:
+                if recorder is not None:
+                    recorder.event("stop", iteration=i,
+                                   reason="no_more_splits")
                 finished_iter = i
                 break
             evaluation_result_list = []
@@ -134,13 +146,31 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
                 for data_name, eval_name, score, _ in e.best_score:
                     booster.best_score.setdefault(data_name, collections.OrderedDict())
                     booster.best_score[data_name][eval_name] = score
+                if recorder is not None:
+                    recorder.iteration(i, evaluation_result_list)
+                    recorder.event("early_stop", iteration=i,
+                                   best_iteration=e.best_iteration)
                 break
+            if recorder is not None:
+                recorder.iteration(i, evaluation_result_list)
+            else:
+                # watchdog heartbeat (LGBM_TPU_HEARTBEAT_FILE) stays armed
+                # even without a recorder; no-op when the env var is unset
+                telemetry_mod.heartbeat(i)
     except KeyboardInterrupt:
         raise
     finally:
         # drain the async tree pipeline (boosting/gbdt.py) so models are
         # materialized before anyone reads booster internals
-        booster._inner.finalize_training()
+        try:
+            booster._inner.finalize_training()
+        finally:
+            if recorder is not None:
+                import sys
+                exc = sys.exc_info()[1]
+                recorder.close(
+                    status="finished" if exc is None else
+                    f"error: {type(exc).__name__}")
     return booster
 
 
@@ -159,7 +189,8 @@ def _check_eval_finite(booster: Booster, results, iteration: int) -> None:
                 % (eval_name, data_name, val, iteration))
 
 
-def _setup_checkpointing(booster: Booster, callbacks: List) -> int:
+def _setup_checkpointing(booster: Booster, callbacks: List,
+                         recorder_ref: Optional[Dict[str, Any]] = None) -> int:
     """When tpu_checkpoint_dir is set: resume the booster (and any
     stateful callbacks) from the newest valid snapshot, register the
     periodic checkpoint callback, and return the iteration to restart
@@ -233,7 +264,13 @@ def _setup_checkpointing(booster: Booster, callbacks: List) -> int:
             f"{getattr(cb, 'checkpoint_key', 'cb')}:{idx}":
                 cb.checkpoint_state()
             for idx, cb in enumerate(stateful)}
-        manager.save(snapshot, snapshot["iteration"])
+        path = manager.save(snapshot, snapshot["iteration"])
+        # narrate the save into the run log (telemetry recorder is
+        # created after this closure — read it through the shared ref)
+        recorder = (recorder_ref or {}).get("r")
+        if recorder is not None:
+            recorder.event("checkpoint_saved",
+                           iteration=int(snapshot["iteration"]), path=path)
 
     callbacks.append(callback_mod.checkpoint(
         _save, interval=max(1, cfg.io.tpu_checkpoint_interval)))

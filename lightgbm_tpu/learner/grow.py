@@ -1123,6 +1123,31 @@ FMETA_KEYS = ("num_bin", "missing_type", "default_bin", "is_categorical",
               "group", "offset", "is_bundled")
 
 
+def schedule_summary(cfg: GrowerConfig) -> dict:
+    """JSON-safe view of the static schedule baked into a compiled
+    grower — the telemetry run-log header's record of WHY this run's
+    pass economics look the way they do (telemetry/runlog.py). Group
+    widths are summarized, not dumped: wide shapes carry thousands."""
+    widths = cfg.group_widths or ()
+    return {
+        "num_leaves": int(cfg.num_leaves),
+        "max_bins": int(cfg.max_bins),
+        "feature_bins": int(cfg.feature_bins),
+        "chunk": int(cfg.chunk),
+        "batch_k": int(cfg.batch_k),
+        "table_mult": int(cfg.table_mult),
+        "hist_bf16": bool(cfg.hist_bf16),
+        "hist_subtract": bool(cfg.hist_subtract),
+        "hist_compact": bool(cfg.hist_compact),
+        "compact_fraction": float(cfg.compact_fraction),
+        "max_depth": int(cfg.max_depth),
+        "data_axis": cfg.data_axis, "feature_axis": cfg.feature_axis,
+        "voting": bool(cfg.voting),
+        "num_groups": len(widths),
+        "group_width_max": int(max(widths)) if widths else int(cfg.max_bins),
+    }
+
+
 def make_grower(cfg: GrowerConfig):
     """Convenience closure binding the static config."""
     def run(binned, grad, hess, row_weight, feature_mask, fmeta):

@@ -33,6 +33,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import log
+from .. import telemetry
 from ..learner.grow import GrowerConfig, grow_tree
 from ..testing import faults
 
@@ -74,12 +75,19 @@ class DataParallelGrower:
         self.cfg = cfg._replace(data_axis=axis)
         self._global_binned = None
         self._global_binned_id = None
+        self._calls = 0
 
     def __call__(self, binned, grad, hess, row_weight, feature_mask,
                  fmeta: Dict, n_valid=None):
         # injection point: a severed/restarting worker surfaces here as
         # a failed collective dispatch (testing/faults.py)
         faults.inject("collective.call")
+        # liveness evidence for watchdogs (scripts/dryrun_multichip.py):
+        # an rc-124 timeout inside a collective leaves the last grower
+        # dispatch this rank reached, not just a dead process
+        self._calls += 1
+        telemetry.heartbeat(self._calls, phase="grower_dispatch")
+        telemetry.counter_add("parallel/grower_calls", 1)
         cfg = self.cfg
         ax = self.axis
         # multi-host: inputs arrive as THIS PROCESS's row shard — assemble
@@ -167,6 +175,9 @@ class FeatureParallelGrower:
     def __call__(self, binned, grad, hess, row_weight, feature_mask, fmeta,
                  n_valid=None):
         faults.inject("collective.call")
+        self._calls = getattr(self, "_calls", 0) + 1
+        telemetry.heartbeat(self._calls, phase="grower_dispatch")
+        telemetry.counter_add("parallel/grower_calls", 1)
         cfg = self.cfg
         ax = self.axis
         from ..learner.grow import FMETA_KEYS, TreeGrowerState

@@ -129,6 +129,28 @@ def global_row_array(local_np, mesh, axis: str):
     return jax.make_array_from_process_local_data(sharding, local_np)
 
 
+def allgather_bytes(blob: bytes):
+    """Gather one variable-length byte blob from every process, in rank
+    order (single-process: the identity). Used by the telemetry export
+    to merge per-rank metric snapshots at end of run — lengths are
+    allgathered first, then the payloads ride one padded uint8 array."""
+    import jax
+    if jax.process_count() <= 1:
+        return [bytes(blob)]
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental import multihost_utils
+    lengths = np.asarray(multihost_utils.process_allgather(
+        jnp.asarray(np.int64(len(blob)))))
+    max_len = int(lengths.max())
+    padded = np.zeros(max_len, np.uint8)
+    padded[:len(blob)] = np.frombuffer(blob, np.uint8)
+    gathered = np.asarray(multihost_utils.process_allgather(
+        jnp.asarray(padded)))
+    return [gathered[r, :int(lengths[r])].tobytes()
+            for r in range(gathered.shape[0])]
+
+
 def agree_on_iteration(iteration: int) -> int:
     """Checkpoint resume under multi-host training: every process holds
     its own row-shard snapshot series, and a preemption can land between

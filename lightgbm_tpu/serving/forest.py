@@ -126,10 +126,14 @@ class CompiledForest:
                     self._cache.move_to_end(key)
                     self.stats["hits"] += 1
                     tracing.counter("predict/stack_cache_hit", 1)
+                    # serving/* mirror: the hit-rate series the export
+                    # surfaces next to the latency histogram
+                    tracing.counter("serving/stack_cache_hit", 1)
                     return hit
             value = build()
             self.stats["restacks"] += 1
             tracing.counter("predict/restack", 1)
+            tracing.counter("serving/restack", 1)
             if self.enabled:
                 self._cache[key] = value
                 while len(self._cache) > _MAX_ENTRIES:

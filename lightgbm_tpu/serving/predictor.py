@@ -22,7 +22,6 @@ layer adds what a serving process needs around it:
 """
 from __future__ import annotations
 
-import collections
 import threading
 import time
 from concurrent.futures import Future
@@ -30,12 +29,15 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from .. import log, tracing
+from .. import log, telemetry, tracing
 from .forest import bucket_ladder
 
-# latency ring size: enough for stable percentiles without unbounded
-# growth in a long-lived serving process
-_LATENCY_WINDOW = 2048
+# latency histogram bounds: 10us..~20s exponential — a fixed-memory
+# distribution replacing the old bounded ring, so p50/p95/p99 cover the
+# predictor's WHOLE service life, not the last window
+_LATENCY_BOUNDS = tuple(1e-5 * (2.0 ** i) for i in range(22))
+# micro-batch size distribution (rows per coalesced dispatch)
+_BATCH_BOUNDS = (1, 2, 4, 8, 16, 32, 64, 128, 256)
 
 
 class Predictor:
@@ -64,7 +66,18 @@ class Predictor:
         self._queue: List = []
         self._batcher: Optional[threading.Thread] = None
         self._closed = False
-        self._latencies = collections.deque(maxlen=_LATENCY_WINDOW)
+        # always-on local instruments (stats() must work with global
+        # telemetry off), registered as SHARED registry instruments so
+        # the Prometheus export reads the same series — one observe per
+        # request, not a local copy plus a registry twin (a later
+        # telemetry.reset() only drops them from export, never from
+        # stats())
+        self._latency_hist = telemetry.registry().register_histogram(
+            telemetry.Histogram("serving/latency_seconds",
+                                bounds=_LATENCY_BOUNDS))
+        self._batch_hist = telemetry.registry().register_histogram(
+            telemetry.Histogram("serving/micro_batch_rows",
+                                bounds=_BATCH_BOUNDS))
         self._counts = {"requests": 0, "rows": 0,
                         "micro_batches": 0, "micro_rows": 0}
         self._warmup_seconds: Optional[float] = None
@@ -119,7 +132,7 @@ class Predictor:
         with self._lock:
             self._counts["requests"] += 1
             self._counts["rows"] += int(arr.shape[0])
-            self._latencies.append(dt)
+        self._latency_hist.observe(dt)
         tracing.counter("serving/requests", 1)
         tracing.counter("serving/rows", int(arr.shape[0]))
         return out
@@ -155,6 +168,7 @@ class Predictor:
                     daemon=True)
                 self._batcher.start()
             self._queue.append((arr, fut))
+            telemetry.gauge_set("serving/queue_depth", len(self._queue))
             self._cv.notify()
         return fut
 
@@ -174,6 +188,7 @@ class Predictor:
                     self._cv.wait(timeout=remaining)
                 batch = self._queue[:self._micro_batch]
                 del self._queue[:len(batch)]
+                telemetry.gauge_set("serving/queue_depth", len(self._queue))
             # claim each future; a client may have cancel()ed while its
             # row sat in the window (request-timeout pattern) — resolving
             # a cancelled future raises and would kill this thread
@@ -191,6 +206,7 @@ class Predictor:
             with self._lock:
                 self._counts["micro_batches"] += 1
                 self._counts["micro_rows"] += len(live)
+            self._batch_hist.observe(len(live))
             tracing.counter("serving/micro_batches", 1)
             for i, (_, fut) in enumerate(live):
                 fut.set_result(res[i])
@@ -207,29 +223,46 @@ class Predictor:
     # ------------------------------------------------------------------
     def stats(self) -> Dict[str, Any]:
         """Counters in the same spirit as tracing's training counters:
-        request/row totals, latency percentiles over the recent window,
-        service throughput, and the forest cache's restack economics."""
+        request/row totals, service-lifetime latency percentiles (from
+        the bucketed telemetry histogram — bucket-resolution estimates,
+        not a bounded recent-window sort), throughput, and the forest
+        cache's restack economics. The aggregates are also mirrored into
+        `serving/*` registry gauges so the Prometheus export carries
+        them without a stats() caller in the loop."""
         with self._lock:
-            lat = sorted(self._latencies)
             counts = dict(self._counts)
+        hist = self._latency_hist.snapshot()
         out: Dict[str, Any] = dict(counts)
         out["model_version"] = int(self._gbdt._compiled_forest.version)
-        out.update({f"stack_{k}": int(v) for k, v in
-                    self._gbdt._compiled_forest.stats.items()})
+        stack = self._gbdt._compiled_forest.stats
+        out.update({f"stack_{k}": int(v) for k, v in stack.items()})
         out["warmup_seconds"] = self._warmup_seconds
         out["warmup_buckets"] = list(self._warmup_buckets)
-        if lat:
-            def pct(p):
-                return lat[min(len(lat) - 1, int(p * len(lat)))]
-            total = sum(lat)
-            out["p50_latency_ms"] = round(pct(0.50) * 1e3, 4)
-            out["p95_latency_ms"] = round(pct(0.95) * 1e3, 4)
-            out["p99_latency_ms"] = round(pct(0.99) * 1e3, 4)
-            out["mean_latency_ms"] = round(total / len(lat) * 1e3, 4)
-            if total > 0:
-                # rows in the ring window / time spent serving them
-                rows_window = counts["rows"] if len(lat) == counts["requests"] \
-                    else None
-                if rows_window is not None:
-                    out["rows_per_second"] = round(rows_window / total, 2)
+        if hist["count"]:
+            out["p50_latency_ms"] = round(
+                self._latency_hist.quantile(0.50) * 1e3, 4)
+            out["p95_latency_ms"] = round(
+                self._latency_hist.quantile(0.95) * 1e3, 4)
+            out["p99_latency_ms"] = round(
+                self._latency_hist.quantile(0.99) * 1e3, 4)
+            out["mean_latency_ms"] = round(
+                hist["sum"] / hist["count"] * 1e3, 4)
+            out["max_latency_ms"] = round(hist["max"] * 1e3, 4)
+            if hist["sum"] > 0:
+                out["rows_per_second"] = round(counts["rows"] / hist["sum"],
+                                               2)
+        if self._micro_batch > 0:
+            with self._cv:
+                out["queue_depth"] = len(self._queue)
+            batch = self._batch_hist.snapshot()
+            if batch["count"]:
+                out["mean_micro_batch_rows"] = round(
+                    batch["sum"] / batch["count"], 2)
+        # cache hit/miss + latency mirrors for the file exporter
+        telemetry.gauge_set("serving/stack_restacks", stack["restacks"])
+        telemetry.gauge_set("serving/stack_hits", stack["hits"])
+        telemetry.gauge_set("serving/model_version", out["model_version"])
+        if hist["count"]:
+            telemetry.gauge_set("serving/p99_latency_ms",
+                                out["p99_latency_ms"])
         return out

@@ -1,0 +1,200 @@
+"""Metrics export: Prometheus text exposition + cross-rank aggregation.
+
+File-target exposition (the node-exporter "textfile collector" pattern):
+`write_prometheus(path)` atomically dumps the registry in the standard
+text format, one file per rank, so a scraper — or a human with grep —
+reads training/serving state without any server embedded in the trainer.
+Counters export as `lgbmtpu_counter_total{name=...}`, span timers as
+`lgbmtpu_phase_seconds_total`/`_count{phase=...}`, histograms with full
+`_bucket{le=...}` series. Every sample carries the caller's extra labels
+(the multihost rank).
+
+Cross-rank aggregation: after a multi-process run every rank holds only
+its shard's counters. `write_cross_rank_aggregate` allgathers each
+rank's JSON snapshot through `parallel.multihost.allgather_bytes` and
+rank 0 writes the merged view (counters/histograms summed, gauges kept
+per-rank under a `rank` label — a heartbeat gauge MUST NOT be summed:
+its per-rank last-seen value is exactly the evidence a hung-rank
+post-mortem needs).
+"""
+from __future__ import annotations
+
+import json
+import re
+from typing import Any, Dict, List, Optional
+
+from . import metrics as metrics_mod
+
+_NAME_SANITIZE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str) -> str:
+    return _NAME_SANITIZE.sub("_", name)
+
+
+def _prom_value(v: float) -> str:
+    """Exact sample rendering: '%g' would truncate to 6 significant
+    digits — off by ~1e3 rows on a 1e7 row counter and lossy for
+    last-seen-iteration gauges. Integers print as integers; floats via
+    repr (shortest exact round-trip)."""
+    f = float(v)
+    if f.is_integer() and abs(f) < 2 ** 63:
+        return str(int(f))
+    return repr(f)
+
+
+def _labels_str(items) -> str:
+    if not items:
+        return ""
+    body = ",".join(f'{_prom_name(str(k))}="{v}"' for k, v in items)
+    return "{" + body + "}"
+
+
+def _merged_labels(base, extra: Optional[Dict[str, str]]):
+    out = list(base or ())
+    for k, v in sorted((extra or {}).items()):
+        out.append((k, v))
+    return out
+
+
+def prometheus_text(snapshot: Dict[str, Any],
+                    extra_labels: Optional[Dict[str, str]] = None) -> str:
+    """Render a registry snapshot (metrics.Registry.snapshot()) in the
+    Prometheus text exposition format."""
+    lines: List[str] = []
+
+    counters = snapshot.get("counters", [])
+    if counters:
+        lines.append("# TYPE lgbmtpu_counter_total counter")
+        for c in counters:
+            labels = _merged_labels([("name", c["name"])] +
+                                    [tuple(kv) for kv in c["labels"]],
+                                    extra_labels)
+            lines.append(f"lgbmtpu_counter_total{_labels_str(labels)} "
+                         f"{_prom_value(c['value'])}")
+
+    gauges = snapshot.get("gauges", [])
+    if gauges:
+        lines.append("# TYPE lgbmtpu_gauge gauge")
+        for g in gauges:
+            labels = _merged_labels([("name", g["name"])] +
+                                    [tuple(kv) for kv in g["labels"]],
+                                    extra_labels)
+            lines.append(f"lgbmtpu_gauge{_labels_str(labels)} "
+                         f"{_prom_value(g['value'])}")
+
+    phases = snapshot.get("phases", [])
+    if phases:
+        lines.append("# TYPE lgbmtpu_phase_seconds_total counter")
+        lines.append("# TYPE lgbmtpu_phase_count_total counter")
+        for p in phases:
+            labels = _merged_labels([("phase", p["name"])], extra_labels)
+            ls = _labels_str(labels)
+            lines.append(f"lgbmtpu_phase_seconds_total{ls} "
+                         f"{p['seconds']:.6f}")
+            lines.append(f"lgbmtpu_phase_count_total{ls} {p['count']}")
+
+    for h in snapshot.get("histograms", []):
+        base = _prom_name("lgbmtpu_" + h["name"])
+        lines.append(f"# TYPE {base} histogram")
+        label_items = [tuple(kv) for kv in h["labels"]]
+        cum = 0
+        for bound, count in zip(h["bounds"], h["buckets"]):
+            cum += count
+            labels = _merged_labels(label_items + [("le", f"{bound:g}")],
+                                    extra_labels)
+            lines.append(f"{base}_bucket{_labels_str(labels)} {cum}")
+        labels = _merged_labels(label_items + [("le", "+Inf")], extra_labels)
+        lines.append(f"{base}_bucket{_labels_str(labels)} {h['count']}")
+        plain = _merged_labels(label_items, extra_labels)
+        lines.append(f"{base}_sum{_labels_str(plain)} "
+                     f"{_prom_value(h['sum'])}")
+        lines.append(f"{base}_count{_labels_str(plain)} {h['count']}")
+
+    return "\n".join(lines) + "\n"
+
+
+def write_prometheus(path: str,
+                     extra_labels: Optional[Dict[str, str]] = None,
+                     snapshot: Optional[Dict[str, Any]] = None) -> str:
+    """Atomically write the (current) registry as Prometheus text."""
+    from .. import checkpoint as ckpt
+    snap = snapshot if snapshot is not None \
+        else metrics_mod.registry().snapshot()
+    ckpt.atomic_write_text(path, prometheus_text(snap, extra_labels))
+    return path
+
+
+# ---------------------------------------------------------------------------
+# cross-rank aggregation
+# ---------------------------------------------------------------------------
+def merge_snapshots(snaps: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Merge per-rank registry snapshots: counters/phases/histogram
+    buckets sum across ranks; gauges stay per-rank (labeled by origin
+    rank by the caller via each snapshot's position)."""
+    counters: Dict[tuple, Dict] = {}
+    phases: Dict[str, Dict] = {}
+    hists: Dict[tuple, Dict] = {}
+    gauges: List[Dict] = []
+    for rank, snap in enumerate(snaps):
+        for c in snap.get("counters", []):
+            key = (c["name"], tuple(tuple(kv) for kv in c["labels"]))
+            agg = counters.setdefault(key, {
+                "name": c["name"], "labels": c["labels"],
+                "value": 0.0, "events": 0})
+            agg["value"] += c["value"]
+            agg["events"] += c["events"]
+        for p in snap.get("phases", []):
+            agg = phases.setdefault(p["name"], {
+                "name": p["name"], "seconds": 0.0, "count": 0})
+            agg["seconds"] += p["seconds"]
+            agg["count"] += p["count"]
+        for h in snap.get("histograms", []):
+            key = (h["name"], tuple(tuple(kv) for kv in h["labels"]),
+                   tuple(h["bounds"]))
+            agg = hists.setdefault(key, {
+                "name": h["name"], "labels": h["labels"],
+                "bounds": list(h["bounds"]),
+                "buckets": [0] * len(h["buckets"]),
+                "count": 0, "sum": 0.0, "min": None, "max": None})
+            agg["buckets"] = [a + b for a, b in
+                              zip(agg["buckets"], h["buckets"])]
+            agg["count"] += h["count"]
+            agg["sum"] += h["sum"]
+            for fld, pick in (("min", min), ("max", max)):
+                if h.get(fld) is not None:
+                    agg[fld] = h[fld] if agg[fld] is None \
+                        else pick(agg[fld], h[fld])
+        for g in snap.get("gauges", []):
+            gg = dict(g)
+            gg["labels"] = list(g["labels"]) + [["rank", str(rank)]]
+            gauges.append(gg)
+    return {"counters": list(counters.values()),
+            "phases": list(phases.values()),
+            "histograms": list(hists.values()),
+            "gauges": gauges}
+
+
+def write_cross_rank_aggregate(directory: str, rank: int,
+                               world: int) -> Optional[str]:
+    """End-of-run collective: every rank contributes its snapshot, rank 0
+    writes `metrics_aggregate.prom`. Must be called by ALL ranks (it is
+    an allgather). Returns the written path on rank 0, None elsewhere."""
+    import os
+
+    from ..parallel.multihost import allgather_bytes
+    blob = json.dumps(metrics_mod.registry().snapshot(),
+                      sort_keys=True).encode("utf-8")
+    blobs = allgather_bytes(blob)
+    if rank != 0:
+        return None
+    snaps = []
+    for b in blobs:
+        try:
+            snaps.append(json.loads(b.decode("utf-8")))
+        except (UnicodeDecodeError, json.JSONDecodeError):  # pragma: no cover
+            snaps.append({})
+    merged = merge_snapshots(snaps)
+    path = os.path.join(directory, "metrics_aggregate.prom")
+    return write_prometheus(path, extra_labels={"world": str(world)},
+                            snapshot=merged)

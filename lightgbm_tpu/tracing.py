@@ -1,110 +1,58 @@
-"""Per-phase timers + profiler hooks (SURVEY.md §5.1).
+"""Back-compat shim over `lightgbm_tpu.telemetry` (the old flat timers).
 
-TPU-native equivalent of the reference's compile-time TIMETAG accumulators
-(`gbdt.cpp:22-30,53-62`, `serial_tree_learner.cpp:10-17,29-37`): named
-wall-clock accumulators around the boosting phases, dumped on demand or at
-interpreter exit when `LGBM_TPU_TIMETAG=1`. Device work is asynchronous
-under JAX, so phases that must attribute device time call `block()` on
-their outputs (only when timing is enabled — timers are zero-cost when
-off).
+This module used to hold the TIMETAG-style global accumulators
+(reference `gbdt.cpp:53-62`); the real implementation now lives in
+`lightgbm_tpu/telemetry/` (labeled registry, run log, compile observer,
+Prometheus export). Every historical entry point keeps its exact
+signature and semantics:
 
-For kernel-level traces, `trace_to(dir)` wraps `jax.profiler.trace`; the
-resulting xplane protobuf is the artifact to inspect with
-`jax.profiler.ProfileData` (see scripts/profile_train.py).
+- `phase(name, block=...)` — span-scoped wall timer (block_until_ready
+  on `block` before the clock stops)
+- `counter(name, value)` / `counters()` — accumulate / read
+  `{name: (total, events)}`
+- `totals()` — `{phase: (seconds, count)}`
+- `enable/enabled/reset/dump/block` — as before; `LGBM_TPU_TIMETAG=1`
+  still enables at import and dumps at exit
+- `trace_to(dir)` — jax.profiler xplane trace wrapper
+
+New code should import `lightgbm_tpu.telemetry` directly.
 """
 from __future__ import annotations
 
 import atexit
 import contextlib
-import os
-import time
-from collections import defaultdict
 from typing import Dict, Tuple
 
-from . import log
+from . import telemetry as _t
 
-_totals: Dict[str, float] = defaultdict(float)
-_counts: Dict[str, int] = defaultdict(int)
-_counters: Dict[str, float] = defaultdict(float)
-_counter_events: Dict[str, int] = defaultdict(int)
-_enabled = os.environ.get("LGBM_TPU_TIMETAG", "") not in ("", "0", "false")
-
-
-def enable(on: bool = True) -> None:
-    global _enabled
-    _enabled = on
-
-
-def enabled() -> bool:
-    return _enabled
-
-
-def reset() -> None:
-    _totals.clear()
-    _counts.clear()
-    _counters.clear()
-    _counter_events.clear()
+enable = _t.enable
+enabled = _t.enabled
+reset = _t.reset
+block = _t.block
+dump = _t.dump
 
 
 def totals() -> Dict[str, Tuple[float, int]]:
-    return {k: (_totals[k], _counts[k]) for k in _totals}
+    return {name: (acc.total, acc.count)
+            for name, acc in _t.registry().phases.items()}
 
 
 def counter(name: str, value: float) -> None:
-    """Accumulate a numeric event counter (e.g. histogram passes, rows
-    contracted) next to the phase timers; dumped with them. Zero-cost
-    when tracing is disabled."""
-    if _enabled:
-        _counters[name] += float(value)
-        _counter_events[name] += 1
+    """Accumulate a numeric event counter; zero-cost when disabled."""
+    _t.counter_add(name, value)
 
 
 def counters() -> Dict[str, Tuple[float, int]]:
-    return {k: (_counters[k], _counter_events[k]) for k in _counters}
+    out: Dict[str, Tuple[float, int]] = {}
+    for c in _t.registry().counters.values():
+        if not c.labels:
+            out[c.name] = (c.value, c.events)
+    return out
 
 
-@contextlib.contextmanager
 def phase(name: str, block=None):
-    """Accumulate wall time under `name`. `block` is an optional array (or
-    pytree) to block_until_ready on before stopping the clock, so async
-    device work is charged to the right phase."""
-    if not _enabled:
-        yield
-        return
-    t0 = time.perf_counter()
-    try:
-        yield
-    finally:
-        if block is not None:
-            import jax
-            jax.block_until_ready(block)
-        _totals[name] += time.perf_counter() - t0
-        _counts[name] += 1
-
-
-def block(x):
-    """Block on device values inside an open phase (when enabled)."""
-    if _enabled and x is not None:
-        import jax
-        jax.block_until_ready(x)
-    return x
-
-
-def dump() -> None:
-    """Log accumulated phase times (reference: the TIMETAG destructor
-    printout, gbdt.cpp:53-62)."""
-    if not _totals and not _counters:
-        return
-    if _totals:
-        log.info("=== phase timers ===")
-        for name in sorted(_totals, key=_totals.get, reverse=True):
-            log.info("%-28s %8.3f s  x%d", name, _totals[name],
-                     _counts[name])
-    if _counters:
-        log.info("=== counters ===")
-        for name in sorted(_counters, key=_counters.get, reverse=True):
-            log.info("%-28s %12.0f  x%d", name, _counters[name],
-                     _counter_events[name])
+    """Accumulate wall time under `name` (telemetry.span)."""
+    return _t.span(name, block=block)
 
 
 @contextlib.contextmanager
@@ -117,5 +65,5 @@ def trace_to(trace_dir: str):
 
 @atexit.register
 def _dump_at_exit() -> None:
-    if _enabled:
-        dump()
+    if _t.enabled():
+        _t.dump()

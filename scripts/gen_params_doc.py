@@ -67,6 +67,18 @@ DESCRIPTIONS = {
                            "are rotated out; corrupt/truncated "
                            "snapshots fall back to the previous good "
                            "one on resume)",
+    "tpu_telemetry_dir": "observability directory: a structured JSONL "
+                         "run log (header + one record per iteration + "
+                         "events + summary; see README Observability) "
+                         "plus end-of-run Prometheus text-exposition "
+                         "metric dumps, one file per rank (empty = off)",
+    "tpu_telemetry": "collect span timers / counters / compile events "
+                     "without writing files (exit dump only — the "
+                     "LGBM_TPU_TIMETAG behavior, config-exposed)",
+    "tpu_telemetry_prometheus": "write metrics_r<rank>.prom (+ the "
+                                "cross-rank metrics_aggregate.prom on "
+                                "rank 0) into tpu_telemetry_dir at end "
+                                "of run",
     "is_predict_raw_score": "predict raw scores instead of transformed",
     "is_predict_leaf_index": "predict leaf indices per tree",
     "is_predict_contrib": "predict TreeSHAP feature contributions",

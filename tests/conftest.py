@@ -34,6 +34,48 @@ def pytest_configure(config):
         "markers", "slow: long benchmark-grade runs excluded from tier-1")
 
 
+def pytest_sessionstart(session):
+    """Stdout hygiene gate: no `lightgbm_tpu/` module may write to
+    stdout via bare print() — everything routes through `log` (stderr /
+    registered callback) or telemetry sinks, so CLI pipelines and the
+    bench driver's JSON-per-line stdout contract stay parseable.
+    Allowlist: the CLI entry points, whose stdout IS the product.
+    Prints explicitly directed at sys.stderr are fine."""
+    import ast
+    import pathlib
+
+    import pytest
+
+    pkg = pathlib.Path(__file__).resolve().parent.parent / "lightgbm_tpu"
+    allow = {"cli.py", "__main__.py"}
+    offenders = []
+    for path in sorted(pkg.rglob("*.py")):
+        if path.name in allow:
+            continue
+        try:
+            tree = ast.parse(path.read_text())
+        except SyntaxError as exc:  # broken module fails loudly here too
+            offenders.append(f"{path.name}: unparseable ({exc})")
+            continue
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "print"):
+                continue
+            file_kw = next((kw.value for kw in node.keywords
+                            if kw.arg == "file"), None)
+            if (isinstance(file_kw, ast.Attribute)
+                    and file_kw.attr == "stderr"):
+                continue
+            offenders.append(
+                f"{path.relative_to(pkg.parent)}:{node.lineno}")
+    if offenders:
+        raise pytest.UsageError(
+            "bare print() to stdout inside lightgbm_tpu/ (route through "
+            "log/telemetry; cli.py and __main__.py are allowlisted): "
+            + ", ".join(offenders))
+
+
 def pytest_collection_modifyitems(config, items):
     """Run the robustness suites (checkpoint/resume, fault injection,
     kill-and-resume cycles) LAST: tier-1 CI runs under a fixed
