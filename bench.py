@@ -366,6 +366,85 @@ def run_amortized(rows=None, iters=None) -> dict:
     }
 
 
+def _ingest_child(mode: str, path: str, rows: int) -> None:
+    """One measured construction in a FRESH process (BENCH_INGEST_CHILD):
+    ru_maxrss is a process-lifetime high-water mark, so streamed and
+    in-memory construction must not share an address space. Prints one
+    JSON line {mode, wall_seconds, mrows_per_s, peak_rss_mb}."""
+    import resource
+
+    import lightgbm_tpu as lgb
+    params = {"max_bin": MAX_BIN, "verbose": -1}
+    if mode == "inmem":
+        params["tpu_ingest"] = False
+    t0 = time.time()
+    ds = lgb.Dataset(path, params=params)
+    ds.construct()
+    wall = time.time() - t0
+    assert ds._inner.num_data == rows, (ds._inner.num_data, rows)
+    peak_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    print(json.dumps({
+        "mode": mode, "wall_seconds": round(wall, 3),
+        "mrows_per_s": round(rows / wall / 1e6, 4),
+        "peak_rss_mb": round(peak_kb / 1024.0, 1),
+        "binned_shape": list(ds._inner.binned.shape),
+    }), flush=True)
+
+
+def run_ingest() -> list:
+    """Ingest benchmarks (BENCH_SHAPE=ingest): streamed two-pass file
+    construction vs the in-memory load-then-bin path, each in its own
+    child process — Mrows/s plus peak RSS, so the memory claim of the
+    streaming subsystem (no raw float matrix) is a measured number, not
+    a design note."""
+    import subprocess
+    import sys
+    import tempfile
+
+    rows = int(os.environ.get("BENCH_INGEST_ROWS", 400_000))
+    X, y = synth_higgs(rows, N_FEATURES)
+    tmp = tempfile.mkdtemp(prefix="bench_ingest_")
+    path = os.path.join(tmp, "ingest.tsv")
+    np.savetxt(path, np.column_stack([y, X]), delimiter="\t", fmt="%.7g")
+    raw_mb = X.nbytes / 1e6
+    del X, y
+
+    out = []
+    results = {}
+    for mode in ("streamed", "inmem"):
+        env = dict(os.environ)
+        env["BENCH_INGEST_CHILD"] = mode
+        env["BENCH_INGEST_PATH"] = path
+        env["BENCH_INGEST_ROWS"] = str(rows)
+        res = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                             env=env, capture_output=True, text=True)
+        line = next((ln for ln in res.stdout.splitlines()
+                     if ln.startswith("{")), None)
+        if res.returncode != 0 or line is None:
+            out.append({"metric": f"ingest_{mode}_construct", "value": None,
+                        "unit": "mrows/s",
+                        "error": (res.stdout + res.stderr)[-400:]})
+            continue
+        results[mode] = json.loads(line)
+    for mode, rec in results.items():
+        detail = {"rows": rows, "features": N_FEATURES,
+                  "raw_float64_mb": round(raw_mb, 1),
+                  "peak_rss_mb": rec["peak_rss_mb"],
+                  "wall_seconds": rec["wall_seconds"]}
+        if len(results) == 2:
+            other = results["inmem" if mode == "streamed" else "streamed"]
+            detail["peak_rss_vs_other_mb"] = other["peak_rss_mb"]
+        out.append({"metric": f"ingest_{mode}_construct",
+                    "value": rec["mrows_per_s"], "unit": "mrows/s",
+                    "vs_baseline": 1.0, "detail": detail})
+    try:
+        os.remove(path)
+        os.rmdir(tmp)
+    except OSError:
+        pass
+    return out
+
+
 def run_predict() -> list:
     """Serving predict benchmarks (BENCH_SHAPE=predict): bulk throughput
     over one large matrix and repeated small-batch latency — the two
@@ -456,6 +535,11 @@ def run_predict() -> list:
 
 
 def main():
+    if os.environ.get("BENCH_INGEST_CHILD"):
+        _ingest_child(os.environ["BENCH_INGEST_CHILD"],
+                      os.environ["BENCH_INGEST_PATH"],
+                      int(os.environ["BENCH_INGEST_ROWS"]))
+        return
     _init_backend_with_retry()
     which = os.environ.get("BENCH_SHAPE", "higgs")
     if which == "amortized":
@@ -463,6 +547,10 @@ def main():
         return
     if which == "predict":
         for entry in run_predict():
+            print(json.dumps(entry), flush=True)
+        return
+    if which == "ingest":
+        for entry in run_ingest():
             print(json.dumps(entry), flush=True)
         return
     names = list(SHAPES) if which == "all" else [which]

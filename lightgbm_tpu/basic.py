@@ -51,6 +51,44 @@ def _data_to_2d(data) -> np.ndarray:
     return arr
 
 
+def _device_landing_factory(params: Dict[str, Any]):
+    """Per-device row sharding at ingest time (tpu_ingest_device_shards):
+    under a single-process data/voting-parallel run, pass 2 lands binned
+    chunks straight into per-device HBM blocks (ingest.ShardedLanding)
+    instead of a host matrix, so the dataset can exceed one device's HBM
+    (and, with the host blocks freed as they ship, most of host RAM).
+    Returns None (host landing) when the conditions don't hold."""
+    if not _parse_value(params.get("tpu_ingest_device_shards", False), bool):
+        return None
+    learner = str(params.get("tree_learner", "serial"))
+    if learner not in ("data", "voting"):
+        log.warning("tpu_ingest_device_shards needs tree_learner=data or "
+                    "voting (got %s); landing on host", learner)
+        return None
+    import jax
+    if jax.process_count() > 1:
+        # multi-process rows ride the loader partition + the grower's
+        # global_row_array assembly; per-device landing is the
+        # single-process N x HBM story
+        log.warning("tpu_ingest_device_shards is single-process only; "
+                    "landing on host")
+        return None
+
+    def factory(num_rows, num_groups, dtype, max_group_bin):
+        from .ingest import ShardedLanding, plan_row_layout
+        layout = plan_row_layout(
+            num_rows, num_groups, max_group_bin,
+            tpu_hist_chunk=int(params.get("tpu_hist_chunk", 65536)),
+            tree_learner=learner, ndev=len(jax.devices()),
+            nproc=jax.process_count())
+        log.info("Ingest: landing %d rows (padded %d) as %d-way "
+                 "per-device row shards", num_rows, layout.n_pad,
+                 layout.ndev)
+        return ShardedLanding(num_rows, num_groups, dtype, layout)
+
+    return factory
+
+
 class Dataset:
     """Lazy dataset wrapper (reference: basic.py:548-1222)."""
 
@@ -138,16 +176,34 @@ class Dataset:
             return self._inner
         params = key_alias_transform(self.params)
         max_bin = int(params.get("max_bin", self.max_bin))
-        cfg = Config.from_params({k: v for k, v in params.items()
-                                  if k not in ("max_bin",)}) \
-            if False else None  # full config not needed for binning
         data = self.data
+        streamed_source = None
         if isinstance(data, str):
-            from .io.parser import load_data_file
-            arr, label = load_data_file(data)
-            if self.label is None and label is not None:
-                self.label = label
-            data = arr
+            # file inputs stream through the ingest subsystem (two-pass
+            # chunked binning, lightgbm_tpu/ingest) — the raw float
+            # matrix never materializes. tpu_ingest=false keeps the old
+            # load-everything path; libsvm and subset() fall back too.
+            use_stream = _parse_value(params.get("tpu_ingest", True), bool) \
+                and self.used_indices is None
+            if use_stream:
+                from .ingest import FileSource
+                try:
+                    streamed_source = FileSource(
+                        data,
+                        chunk_rows=int(params.get("tpu_ingest_chunk_rows",
+                                                  65536)),
+                        has_header=_parse_value(
+                            params.get("has_header", False), bool))
+                except ValueError:
+                    streamed_source = None  # libsvm: dense-load below
+            if streamed_source is None:
+                from .io.parser import load_data_file
+                arr, label = load_data_file(
+                    data, has_header=_parse_value(
+                        params.get("has_header", False), bool))
+                if self.label is None and label is not None:
+                    self.label = label
+                data = arr
         else:
             data = _data_to_2d(data)
         if self.used_indices is not None:
@@ -224,8 +280,8 @@ class Dataset:
             init_score = np.asarray(init_score)[self.used_indices]
 
         ref_inner = self.reference._lazy_init() if self.reference is not None else None
-        self._inner = _InnerDataset.from_numpy(
-            data, label=label, max_bin=max_bin,
+        build_kwargs = dict(
+            label=label, max_bin=max_bin,
             min_data_in_bin=int(params.get("min_data_in_bin", 3)),
             bin_construct_sample_cnt=int(params.get("bin_construct_sample_cnt", 200000)),
             data_random_seed=int(params.get("data_random_seed", 1)),
@@ -235,7 +291,7 @@ class Dataset:
                 params.get("zero_as_missing", False), bool),
             feature_names=feature_names,
             weight=weight, group=group, init_score=init_score,
-            reference=ref_inner, keep_raw=not self.free_raw_data,
+            reference=ref_inner,
             # EFB (dataset.cpp:66-211); feature-parallel shards features
             # 1:1 onto stored columns, so bundling is disabled there
             # (warned below — sparse data keeps its full dense width)
@@ -243,7 +299,20 @@ class Dataset:
                            and params.get("tree_learner", "serial") != "feature"),
             max_conflict_rate=float(params.get("max_conflict_rate", 0.0)),
             sparse_threshold=float(params.get("sparse_threshold", 0.8)),
-            mappers=self._preset_mappers)
+            mappers=self._preset_mappers,
+            # device landing is for the TRAINING matrix only: valid sets
+            # (reference datasets) are consumed host-side by add_valid
+            landing_factory=(_device_landing_factory(params)
+                             if ref_inner is None else None))
+        if streamed_source is not None:
+            from .ingest import build_inner
+            self._inner = build_inner(streamed_source,
+                                      keep_raw=False, **build_kwargs)
+        else:
+            self._inner = _InnerDataset.from_numpy(
+                data, keep_raw=not self.free_raw_data,
+                chunk_rows=int(params.get("tpu_ingest_chunk_rows", 65536)),
+                **build_kwargs)
         self._constructed_max_bin = max_bin
         if (params.get("tree_learner", "serial") == "feature"
                 and _parse_value(params.get("enable_bundle", True), bool)):

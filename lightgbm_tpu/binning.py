@@ -496,24 +496,29 @@ def _distinct_with_zero(sorted_values: np.ndarray, zero_cnt: int):
     return distinct, counts.astype(np.int64)
 
 
-def find_bin_mappers(data: np.ndarray, max_bin: int, min_data_in_bin: int = 3,
-                     min_split_data: int = 0,
-                     sample_cnt: int = 200000, seed: int = 1,
-                     categorical_features: Optional[Sequence[int]] = None,
-                     use_missing: bool = True,
-                     zero_as_missing: bool = False) -> List[BinMapper]:
-    """Build per-feature BinMappers from a row-sampled slice of the data
-    (reference: DatasetLoader::ConstructBinMappersFromTextData,
-    dataset_loader.cpp:666-817 — sampling via `bin_construct_sample_cnt`)."""
-    n, f = data.shape
+def sample_row_indices(n: int, sample_cnt: int = 200000,
+                       seed: int = 1) -> Optional[np.ndarray]:
+    """The sorted row indices `find_bin_mappers` samples for bin finding,
+    or None when every row is used (n <= sample_cnt). Split out so the
+    streaming ingest subsystem (lightgbm_tpu/ingest) can gather exactly
+    these rows from a chunk stream and land on bit-identical bin bounds."""
+    if n <= sample_cnt:
+        return None
     rng = np.random.RandomState(seed)
-    if n > sample_cnt:
-        idx = rng.choice(n, size=sample_cnt, replace=False)
-        sample = data[np.sort(idx)]
-        total = sample_cnt
-    else:
-        sample = data
-        total = n
+    return np.sort(rng.choice(n, size=sample_cnt, replace=False))
+
+
+def mappers_from_sample(sample: np.ndarray, total: int, max_bin: int,
+                        min_data_in_bin: int = 3, min_split_data: int = 0,
+                        categorical_features: Optional[Sequence[int]] = None,
+                        use_missing: bool = True,
+                        zero_as_missing: bool = False) -> List[BinMapper]:
+    """Per-feature BinMappers from an already-gathered row sample.
+
+    The shared core of `find_bin_mappers` (in-memory) and the ingest
+    pass-1 sketch (streamed): both hand it the same sampled rows, so both
+    produce bit-identical bounds."""
+    f = sample.shape[1]
     cats = set(categorical_features or [])
 
     def _one(j):
@@ -531,3 +536,21 @@ def find_bin_mappers(data: np.ndarray, max_bin: int, min_data_in_bin: int = 3,
         with ThreadPoolExecutor(max_workers=8) as ex:
             return list(ex.map(_one, range(f)))
     return [_one(j) for j in range(f)]
+
+
+def find_bin_mappers(data: np.ndarray, max_bin: int, min_data_in_bin: int = 3,
+                     min_split_data: int = 0,
+                     sample_cnt: int = 200000, seed: int = 1,
+                     categorical_features: Optional[Sequence[int]] = None,
+                     use_missing: bool = True,
+                     zero_as_missing: bool = False) -> List[BinMapper]:
+    """Build per-feature BinMappers from a row-sampled slice of the data
+    (reference: DatasetLoader::ConstructBinMappersFromTextData,
+    dataset_loader.cpp:666-817 — sampling via `bin_construct_sample_cnt`)."""
+    n, _ = data.shape
+    idx = sample_row_indices(n, sample_cnt, seed)
+    sample = data if idx is None else data[idx]
+    total = n if idx is None else sample_cnt
+    return mappers_from_sample(sample, total, max_bin, min_data_in_bin,
+                               min_split_data, categorical_features,
+                               use_missing, zero_as_missing)

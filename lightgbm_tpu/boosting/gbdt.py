@@ -316,35 +316,19 @@ class GBDT:
                       "voting (got %s)" % self._tree_learner_kind)
         local_dev = max(1, ndev // nproc)
 
-        chunk = min(self.config.tree.tpu_hist_chunk, 1 << 20)
-        # The histogram kernels tile the GROUP axis into blocks of
-        # budget/(chunk*B) groups each (ops/histogram.plan_group_blocks),
-        # so the row chunk no longer shrinks with G*B (the round-3 scheme
-        # collapsed to 512-row chunks at Epsilon-like G*B ~ 128k). Cap the
-        # chunk only enough to keep the unrolled block count ~<= 16 per
-        # pass, with an 8192-row FLOOR: Bosch-shape (G*B ~ 213k) passes
-        # run 1.6x faster at 8192-row chunks than at 4096 even though the
-        # plan widens to ~32 blocks, while Epsilon-shape (G*B ~ 128k)
-        # training collapses 4x if pushed from 8192 to 16384-row chunks —
-        # measured r4 on v5e, so: floor 8192, target 16 blocks.
-        gb = max(1, train_data.num_groups * train_data.max_num_bin())
-        target = max(1, (16 << 26) // gb)
-        chunk = min(chunk, max(8192, 1 << int(np.floor(np.log2(target)))))
-        self._chunk = int(min(chunk, max(256, 1 << int(np.ceil(np.log2(max(n, 1)))))))
-        row_multiple = self._chunk * (local_dev if nproc > 1 else ndev) \
-            if self._tree_learner_kind in ("data", "voting") else self._chunk
-        m_count = (n + row_multiple - 1) // row_multiple
-        # bucket the padded size into coarse steps (worst case +25% rows:
-        # granule = next_pow2/8) so nearby row counts share one compiled
-        # signature; the grower skips all-padding chunks via a dynamic
-        # trip count (n_valid), so the extra padding costs memory only,
-        # not compute (multi-host runs keep minimal n_valid=None padding
-        # semantics but also bucket, trading some compute for signatures)
-        if m_count > 1:
-            p2 = 1 << (m_count - 1).bit_length()
-            g = max(1, p2 // 8)
-            m_count = ((m_count + g - 1) // g) * g
-        n_pad = m_count * row_multiple
+        # row-padding plan: chunk capped by the group-block budget, rows
+        # padded to a chunk (x shard) multiple, padded size bucketed into
+        # coarse power-of-two granules so nearby row counts share one
+        # compiled signature (full rationale in ingest/landing.py, where
+        # the plan lives so the streaming ingest subsystem can land
+        # per-device shards that are byte-compatible with this init)
+        from ..ingest.landing import plan_row_layout
+        layout = plan_row_layout(
+            n, train_data.num_groups, train_data.max_num_bin(),
+            tpu_hist_chunk=self.config.tree.tpu_hist_chunk,
+            tree_learner=self._tree_learner_kind, ndev=ndev, nproc=nproc)
+        self._chunk = layout.chunk
+        n_pad = layout.n_pad
         if nproc > 1:
             # every process must contribute an equal-sized row block to
             # the global array: pad all shards to the largest
@@ -354,7 +338,26 @@ class GBDT:
         self._n = n
         self._n_pad = n_pad
 
-        binned_host = _pad_to(train_data.binned, n_pad)
+        # ingest may have landed the binned matrix as per-device row
+        # shards already (ingest.ShardedLanding); reuse it when its
+        # padding matches this plan, otherwise gather and re-pad
+        device_binned = getattr(train_data, "device_binned", None)
+        if device_binned is not None:
+            usable = (int(device_binned.shape[0]) == n_pad and nproc == 1
+                      and self._tree_learner_kind in ("data", "voting"))
+            if usable:
+                binned_host = None
+            else:
+                log.warning(
+                    "Device-landed dataset does not match the training "
+                    "layout (rows %d vs %d, learner %s); gathering to "
+                    "host and re-padding", int(device_binned.shape[0]),
+                    n_pad, self._tree_learner_kind)
+                binned_host = _pad_to(
+                    np.asarray(device_binned)[:n], n_pad)
+                device_binned = None
+        else:
+            binned_host = _pad_to(train_data.binned, n_pad)
         fm = train_data.feature_meta_arrays()
         self._max_bins = int(train_data.max_num_bin())
 
@@ -575,7 +578,10 @@ class GBDT:
                 and train_data.num_groups != train_data.num_features):
             log.fatal("feature-parallel requires unbundled features; "
                       "construct the Dataset with enable_bundle=false")
-        self._binned = jnp.asarray(binned_host)
+        # a device-landed matrix is already sharded the way the
+        # data/voting shard_map wants (P(data, None)) — zero resharding
+        self._binned = device_binned if device_binned is not None \
+            else jnp.asarray(binned_host)
         # logical (possibly shard-padded) feature count for feature_fraction
         # masks; the stored binned width is the GROUP count (EFB)
         self._num_features_padded = int(fm["num_bin"].shape[0])

@@ -57,6 +57,10 @@ _FINGERPRINT_EXCLUDE = {
     # observability never changes the training trajectory: a resumed run
     # may add/move/drop its telemetry sinks freely
     "tpu_telemetry_dir", "tpu_telemetry", "tpu_telemetry_prometheus",
+    # ingest mechanics are bit-transparent (streamed/in-memory/cached
+    # construction produce identical datasets at any chunk size or
+    # landing, tests/test_ingest.py) — a resumed run may change them
+    "tpu_ingest", "tpu_ingest_chunk_rows", "tpu_ingest_device_shards",
     "output_model", "output_result", "input_model", "convert_model",
     "config_file", "machine_list_file", "snapshot_freq", "verbose",
     "metric_freq", "num_iterations", "num_threads", "task",

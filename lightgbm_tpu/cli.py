@@ -60,15 +60,47 @@ def parse_cli_params(argv: List[str]) -> Dict[str, str]:
 def _check_binary_dataset(path: str):
     """Binary-dataset fast path (reference: CheckCanLoadFromBin,
     dataset_loader.cpp:240-263 — `file` or `file.bin` with the magic
-    token loads without re-parsing/re-binning)."""
+    token loads without re-parsing/re-binning). Recognizes both the v2
+    ingest cache and the legacy v1 artifact."""
     from .dataset import _BINARY_MAGIC
+    from .ingest import CACHE_MAGIC
+    probe = max(len(_BINARY_MAGIC), len(CACHE_MAGIC))
     for cand in (path, path + ".bin"):
         if not os.path.exists(cand):
             continue
         with open(cand, "rb") as fh:
-            if fh.read(len(_BINARY_MAGIC)) == _BINARY_MAGIC:
-                return cand
+            head = fh.read(probe)
+        if head.startswith(_BINARY_MAGIC) or head.startswith(CACHE_MAGIC):
+            return cand
     return None
+
+
+def _cache_fingerprint(data_path: str, cfg: Config):
+    """The (source identity, binning params) fingerprint a cache built
+    from `data_path` under `cfg` must carry. None when the data file is
+    gone (a cache shipped without its source can't be source-verified)."""
+    from .ingest import (binning_params_fingerprint_fields,
+                         ingest_fingerprint)
+    params = binning_params_fingerprint_fields(
+        max_bin=cfg.io.max_bin, min_data_in_bin=cfg.io.min_data_in_bin,
+        bin_construct_sample_cnt=cfg.io.bin_construct_sample_cnt,
+        data_random_seed=cfg.io.data_random_seed,
+        use_missing=cfg.io.use_missing,
+        zero_as_missing=cfg.io.zero_as_missing,
+        enable_bundle=cfg.io.enable_bundle,
+        max_conflict_rate=cfg.io.max_conflict_rate,
+        sparse_threshold=cfg.io.sparse_threshold)
+    params["categorical_column"] = cfg.io.categorical_column
+    params["has_header"] = cfg.io.has_header
+    if not os.path.exists(data_path):
+        return None
+    from .ingest import FileSource
+    try:
+        source = FileSource(
+            data_path, has_header=cfg.io.has_header).describe()
+    except ValueError:  # libsvm: no streamed identity to pin
+        return None
+    return ingest_fingerprint(source, params)
 
 
 def _build_dataset(path: str, params: Dict, cfg: Config,
@@ -107,10 +139,21 @@ def _build_dataset(path: str, params: Dict, cfg: Config,
         if cfg.io.enable_load_from_binary_file else None
     if bin_path is not None and reference is None:
         from .dataset import Dataset as InnerDataset
+        from .ingest import CacheMismatch
+        expected = _cache_fingerprint(path, cfg) \
+            if bin_path != path else None
+        if expected is None and bin_path != path:
+            log.warning("Cannot verify %s against its source (data file "
+                        "unreadable); trusting the cache", bin_path)
         log.info("Loading binary dataset from %s (binning params come "
                  "from the cache; enable_load_from_binary_file=false "
                  "re-bins)", bin_path)
-        ds = Dataset._from_inner(InnerDataset.load_binary(bin_path))
+        try:
+            inner = InnerDataset.load_binary(
+                bin_path, expected_fingerprint=expected)
+        except CacheMismatch as exc:
+            log.fatal(str(exc))
+        ds = Dataset._from_inner(inner)
     elif cfg.io.use_two_round_loading and reference is None:
         from .parallel.loader import two_round_load
         log.info("Two-round loading %s", path)
@@ -126,13 +169,15 @@ def _build_dataset(path: str, params: Dict, cfg: Config,
             sparse_threshold=cfg.io.sparse_threshold)
         ds = Dataset._from_inner(inner)
     else:
-        data, label = load_data_file(path, has_header=has_header)
-        ds = Dataset(data, label=label, params=dict(params),
-                     reference=reference)
+        # lazy wrapper: construction streams through the ingest
+        # subsystem (chunked two-pass binning — the raw float matrix
+        # never materializes; tpu_ingest=false restores the old path)
+        ds = Dataset(path, params=dict(params), reference=reference)
     ds = _load_sidecars(ds, path, None)
     if cfg.io.is_save_binary_file and bin_path is None:
         ds.construct()
-        ds._inner.save_binary(path + ".bin")
+        fp = _cache_fingerprint(path, cfg)
+        ds._inner.save_binary(path + ".bin", fingerprint=fp or "")
     return ds
 
 
@@ -174,6 +219,13 @@ def run_train(params: Dict, cfg: Config) -> None:
     """Reference: Application::InitTrain + Train (application.cpp:190-234)."""
     if not cfg.data:
         log.fatal("No training data specified (data=...)")
+    if cfg.io.tpu_telemetry_dir or cfg.io.tpu_telemetry:
+        # armed BEFORE the dataset build so the ingest phase (pass 1/2
+        # spans, rows/bytes/chunks counters, cache hits) lands in the
+        # registry the run log snapshots
+        from . import telemetry
+        telemetry.enable(True)
+        telemetry.install_observer()
     log.info("Loading train data from %s", cfg.data)
     train_set = _build_dataset(cfg.data, params, cfg)
     valid_sets, valid_names = [], []

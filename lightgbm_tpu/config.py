@@ -156,6 +156,19 @@ class IOConfig:
     # write the end-of-run Prometheus exposition files (disable to keep
     # only the JSONL run log in tpu_telemetry_dir)
     tpu_telemetry_prometheus: bool = True
+    # streaming ingest subsystem (lightgbm_tpu/ingest): file/array
+    # construction runs as a chunked two-pass pipeline (pass 1 sketches
+    # bin bounds from a streamed row sample, pass 2 re-streams and bins
+    # against the frozen bounds), bit-identical to in-memory
+    # construction at any chunk size; false restores the
+    # load-everything-then-bin path
+    tpu_ingest: bool = True
+    # rows per streamed ingest chunk (pass 1 and pass 2)
+    tpu_ingest_chunk_rows: int = 65536
+    # land pass-2 output directly as per-device row shards under a
+    # single-process data/voting-parallel mesh (host blocks are freed as
+    # they ship, so the binned matrix can exceed one device's HBM)
+    tpu_ingest_device_shards: bool = False
     is_predict_raw_score: bool = False
     is_predict_leaf_index: bool = False
     is_predict_contrib: bool = False

@@ -24,8 +24,7 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from . import log
-from .binning import (BIN_CATEGORICAL, BIN_NUMERICAL, BinMapper,
-                      find_bin_mappers)
+from .binning import BIN_CATEGORICAL, BIN_NUMERICAL, BinMapper
 
 _BINARY_MAGIC = b"lightgbm_tpu.dataset.v1\n"
 
@@ -117,6 +116,12 @@ class Dataset:
         self.num_total_features: int = 0
         self.max_bin: int = 255
         self.groups = None  # efb.FeatureGroups over used features
+        # device-landed alternative to `binned` (ingest.ShardedLanding):
+        # a row-padded jax.Array sharded over the data mesh; `binned`
+        # stays None and `_num_rows` carries the real row count
+        self.device_binned = None
+        self.device_layout = None
+        self._num_rows: int = 0
 
     # ------------------------------------------------------------------
     @classmethod
@@ -136,90 +141,50 @@ class Dataset:
                    enable_bundle: bool = True,
                    max_conflict_rate: float = 0.0,
                    sparse_threshold: float = 0.8,
-                   mappers: Optional[List[BinMapper]] = None) -> "Dataset":
+                   mappers: Optional[List[BinMapper]] = None,
+                   chunk_rows: int = 65536,
+                   landing_factory=None) -> "Dataset":
         """Build a Dataset from a dense float matrix.
 
         When `reference` is given, its BinMappers are reused so validation
         data lands in the same bin space (reference: Dataset::CreateValid,
         dataset.cpp + python basic.py set_reference chain).
+
+        Construction rides the streaming ingest subsystem
+        (lightgbm_tpu/ingest): the matrix is streamed in row chunks
+        through the same two-pass sketch-then-bin pipeline files use, so
+        in-memory and streamed construction are one code path (and
+        bit-identical by construction, tests/test_ingest.py).
         """
         data = np.asarray(data)
         if data.ndim != 2:
             log.fatal("Dataset data must be 2-dimensional")
-        n, f = data.shape
-        ds = cls()
-        ds.num_total_features = f
-        ds.max_bin = max_bin if reference is None else reference.max_bin
-        ds.feature_names = list(feature_names) if feature_names is not None else \
-            [f"Column_{i}" for i in range(f)]
-
-        if reference is not None:
-            if f != reference.num_total_features:
-                log.fatal("Validation data feature count (%d) != train (%d)"
-                          % (f, reference.num_total_features))
-            ds.mappers = reference.mappers
-            ds.used_features = reference.used_features
-            ds.groups = reference.groups
-        elif mappers is not None:
-            # pre-computed BinMappers (C API sampled-column / push-rows
-            # streaming path, c_api.h:67-141: bins come from the sample,
-            # rows arrive later)
-            ds.mappers = list(mappers)
-            ds.used_features = [j for j, m in enumerate(ds.mappers)
-                                if not m.is_trivial]
-        else:
-            ds.mappers = find_bin_mappers(
-                data.astype(np.float64, copy=False), max_bin, min_data_in_bin,
-                min_split_data, bin_construct_sample_cnt, data_random_seed,
-                categorical_features, use_missing, zero_as_missing)
-            ds.used_features = [j for j, m in enumerate(ds.mappers) if not m.is_trivial]
-            if not ds.used_features:
-                log.warning("All features are trivial (constant); "
-                            "model will predict a constant")
-
-        # per-feature binning in a thread pool: searchsorted and the mask
-        # ops release the GIL, and the single-threaded column loop was
-        # ~4s of dataset construction at 2M x 28
-        from concurrent.futures import ThreadPoolExecutor
-
-        def _bin_col(j):
-            return ds.mappers[j].values_to_bins(
-                np.asarray(data[:, j], dtype=np.float64))
-
-        if len(ds.used_features) > 4 and data.shape[0] > 100_000:
-            with ThreadPoolExecutor(max_workers=8) as ex:
-                cols = list(ex.map(_bin_col, ds.used_features))
-        else:
-            cols = [_bin_col(j) for j in ds.used_features]
-        num_bins = np.asarray(
-            [ds.mappers[j].num_bin for j in ds.used_features], np.int32)
-        default_bins = np.asarray(
-            [ds.mappers[j].default_bin for j in ds.used_features], np.int32)
-        if ds.groups is None:
-            from .efb import find_groups
-            ds.groups = find_groups(
-                cols, default_bins, num_bins, enable_bundle=enable_bundle,
-                max_conflict_rate=max_conflict_rate,
-                sparse_threshold=sparse_threshold, seed=data_random_seed)
-        ds.binned = (ds.groups.bundle_rows(cols, default_bins) if cols
-                     else np.zeros((n, 0), dtype=np.uint8))
-        if keep_raw:
-            ds.raw = data
-        ds.metadata = Metadata(n)
-        if label is not None:
-            ds.metadata.set_label(label)
-        if weight is not None:
-            ds.metadata.set_weights(weight)
-        if group is not None:
-            ds.metadata.set_group(group)
-        if init_score is not None:
-            ds.metadata.set_init_score(init_score)
-        return ds
+        from .ingest import ArraySource, build_inner
+        return build_inner(
+            ArraySource(data, chunk_rows=chunk_rows),
+            max_bin=max_bin, min_data_in_bin=min_data_in_bin,
+            min_split_data=min_split_data,
+            bin_construct_sample_cnt=bin_construct_sample_cnt,
+            data_random_seed=data_random_seed,
+            categorical_features=categorical_features,
+            use_missing=use_missing, zero_as_missing=zero_as_missing,
+            feature_names=feature_names, label=label, weight=weight,
+            group=group, init_score=init_score, reference=reference,
+            mappers=mappers, enable_bundle=enable_bundle,
+            max_conflict_rate=max_conflict_rate,
+            sparse_threshold=sparse_threshold, keep_raw=keep_raw,
+            landing_factory=landing_factory)
 
     # ------------------------------------------------------------------
     @property
     def num_data(self) -> int:
-        return 0 if self.binned is None else self.binned.shape[0]
+        if self.binned is not None:
+            return self.binned.shape[0]
+        # device-landed matrix: the jax.Array is row-PADDED; the real
+        # row count was recorded at landing time
+        if self.device_binned is not None:
+            return self._num_rows
+        return 0
 
     @property
     def num_features(self) -> int:
@@ -229,7 +194,11 @@ class Dataset:
 
     @property
     def num_groups(self) -> int:
-        return 0 if self.binned is None else self.binned.shape[1]
+        if self.binned is not None:
+            return self.binned.shape[1]
+        if self.device_binned is not None:
+            return int(self.device_binned.shape[1])
+        return 0
 
     @property
     def has_bundles(self) -> bool:
@@ -292,37 +261,27 @@ class Dataset:
 
     # ------------------------------------------------------------------
     # binary serialization (reference: Dataset::SaveBinaryFile, dataset.h:386,
-    # DatasetLoader::LoadFromBinFile, dataset_loader.cpp:265-430)
-    def save_binary(self, filename: str) -> None:
-        import json
-        meta = {
-            "feature_names": self.feature_names,
-            "used_features": self.used_features,
-            "num_total_features": self.num_total_features,
-            "max_bin": self.max_bin,
-            "mappers": [m.to_dict() for m in self.mappers],
-            "groups": ([[int(j) for j in g] for g in self.groups.groups]
-                       if self.groups is not None else None),
-        }
-        meta_bytes = json.dumps(meta).encode()
-        with open(filename, "wb") as fh:
-            fh.write(_BINARY_MAGIC)
-            fh.write(struct.pack("<q", len(meta_bytes)))
-            fh.write(meta_bytes)
-            for arr, code in [(self.binned, b"B"), (self.metadata.label, b"L"),
-                              (self.metadata.weights, b"W"),
-                              (self.metadata.query_boundaries, b"Q"),
-                              (self.metadata.init_score, b"I")]:
-                if arr is None:
-                    fh.write(b"N")
-                    continue
-                fh.write(code)
-                header = np.lib.format.header_data_from_array_1_0(np.asarray(arr))
-                np.save(fh, np.asarray(arr), allow_pickle=False)
-        log.info("Saved binary dataset to %s", filename)
+    # DatasetLoader::LoadFromBinFile, dataset_loader.cpp:265-430).
+    # Writes ride the ingest cache (versioned + checksummed + mmap-able,
+    # ingest/cache.py); the v1 reader below stays for old artifacts.
+    def save_binary(self, filename: str, fingerprint: str = "") -> None:
+        from .ingest import save_cache
+        save_cache(self, filename, fingerprint=fingerprint)
 
     @classmethod
-    def load_binary(cls, filename: str) -> "Dataset":
+    def load_binary(cls, filename: str, expected_fingerprint=None,
+                    mmap_binned: bool = True) -> "Dataset":
+        from .ingest import CACHE_MAGIC, load_cache
+        with open(filename, "rb") as fh:
+            head = fh.read(max(len(CACHE_MAGIC), len(_BINARY_MAGIC)))
+        if head.startswith(CACHE_MAGIC):
+            return load_cache(filename,
+                              expected_fingerprint=expected_fingerprint,
+                              mmap_binned=mmap_binned)
+        return cls._load_binary_v1(filename)
+
+    @classmethod
+    def _load_binary_v1(cls, filename: str) -> "Dataset":
         import json
         ds = cls()
         with open(filename, "rb") as fh:

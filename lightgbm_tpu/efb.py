@@ -113,11 +113,29 @@ class FeatureGroups:
         return out
 
 
+EFB_SAMPLE_CNT = 50_000
+
+
+def efb_sample_indices(n: int, sample_cnt: int = EFB_SAMPLE_CNT,
+                       seed: int = 1) -> Optional[np.ndarray]:
+    """The sorted row indices `find_groups` samples to estimate feature
+    exclusivity, or None when every row is used (n <= sample_cnt). Shared
+    with the streaming ingest subsystem (lightgbm_tpu/ingest), which
+    gathers exactly these rows from a chunk stream so streamed and
+    in-memory construction agree on the bundle layout bit-for-bit."""
+    if n <= sample_cnt:
+        return None
+    rng = np.random.RandomState(seed)
+    sample = rng.choice(n, size=sample_cnt, replace=False)
+    sample.sort()
+    return sample
+
+
 def find_groups(feature_bins: List[np.ndarray], default_bins: np.ndarray,
                 num_bins: np.ndarray, *, enable_bundle: bool = True,
                 max_conflict_rate: float = 0.0,
                 sparse_threshold: float = 0.8,
-                sample_cnt: int = 50_000, seed: int = 1,
+                sample_cnt: int = EFB_SAMPLE_CNT, seed: int = 1,
                 max_group_bins: Optional[int] = None) -> FeatureGroups:
     """Greedy conflict-bounded grouping (reference: FindGroups,
     dataset.cpp:66-139).
@@ -134,18 +152,38 @@ def find_groups(feature_bins: List[np.ndarray], default_bins: np.ndarray,
     n = len(feature_bins[0])
     if not enable_bundle or f == 1:
         return FeatureGroups([[j] for j in range(f)], num_bins)
+    idx = efb_sample_indices(n, sample_cnt, seed)
+    sampled = feature_bins if idx is None else \
+        [feature_bins[j][idx] for j in range(f)]
+    return find_groups_sampled(sampled, default_bins, num_bins,
+                               enable_bundle=enable_bundle,
+                               max_conflict_rate=max_conflict_rate,
+                               sparse_threshold=sparse_threshold,
+                               max_group_bins=max_group_bins)
+
+
+def find_groups_sampled(sample_bins: List[np.ndarray],
+                        default_bins: np.ndarray, num_bins: np.ndarray, *,
+                        enable_bundle: bool = True,
+                        max_conflict_rate: float = 0.0,
+                        sparse_threshold: float = 0.8,
+                        max_group_bins: Optional[int] = None
+                        ) -> FeatureGroups:
+    """The grouping core over an ALREADY-SAMPLED set of binned rows
+    (`sample_bins[j]` holds feature j's bins for the sampled rows only).
+    `find_groups` is the in-memory wrapper; the ingest pass-1 sketch
+    calls this directly with the rows `efb_sample_indices` named."""
+    f = len(sample_bins)
+    if f == 0:
+        return FeatureGroups([], num_bins)
+    if not enable_bundle or f == 1:
+        return FeatureGroups([[j] for j in range(f)], num_bins)
     if max_group_bins is None:
         max_group_bins = pick_max_group_bins(num_bins)
 
-    rng = np.random.RandomState(seed)
-    if n > sample_cnt:
-        sample = rng.choice(n, size=sample_cnt, replace=False)
-        sample.sort()
-    else:
-        sample = np.arange(n)
-    s = len(sample)
+    s = len(sample_bins[0])
 
-    nz_masks = [feature_bins[j][sample] != default_bins[j] for j in range(f)]
+    nz_masks = [sample_bins[j] != default_bins[j] for j in range(f)]
     nz_counts = np.asarray([int(m.sum()) for m in nz_masks])
 
     dense = nz_counts > (1.0 - sparse_threshold) * s

@@ -98,6 +98,24 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
     recorder_ref["r"] = recorder
     if recorder is not None and start_iter > 0:
         recorder.event("resume", iteration=start_iter)
+    if recorder is not None:
+        # dataset-construction trail: the ingest subsystem's counters
+        # (rows/bytes/chunks, cache hits) and phase walls accumulated
+        # BEFORE this recorder's baseline — surfaced as one event so the
+        # run log says how the training data came to be (a cache hit
+        # shows cache_hit>0 with no pass1/pass2 spans)
+        reg = telemetry_mod.registry()
+        ingest_counters = {
+            c.name.split("/", 1)[1]: c.value
+            for c in reg.counters.values()
+            if c.name.startswith("ingest/") and not c.labels and c.value}
+        ingest_phases = {
+            name.split("/", 1)[1]: round(acc.total, 6)
+            for name, acc in reg.phases.items()
+            if name.startswith("ingest/") and acc.count}
+        if ingest_counters or ingest_phases:
+            recorder.event("ingest", counters=ingest_counters,
+                           phase_seconds=ingest_phases)
 
     callbacks_before = [cb for cb in callbacks if getattr(cb, "before_iteration", False)]
     callbacks_after = [cb for cb in callbacks if not getattr(cb, "before_iteration", False)]

@@ -185,28 +185,15 @@ def find_bins_distributed(sample: np.ndarray, rank: int, num_machines: int,
 def iter_parsed_chunks(path: str, has_header: bool = False,
                        chunk_rows: int = 65536):
     """Yield [<=chunk_rows, 1+F] float64 blocks of a delimited file without
-    ever materializing the whole matrix (reference: the two-round loaders'
-    per-block ExtractFeaturesFromFile, dataset_loader.cpp:630-665)."""
-    from ..io.parser import _parse_float, detect_format
+    ever materializing the whole matrix (the ingest subsystem's shared
+    chunk parser, ingest/sources.iter_raw_file_chunks)."""
+    from ..io.parser import detect_format
+    from ..ingest.sources import iter_raw_file_chunks
     fmt = detect_format(path, has_header)
     delim = {"csv": ",", "tsv": None}.get(fmt)
     if fmt == "libsvm":
         log.fatal("two-round loading supports delimited files only")
-    with open(path) as fh:
-        if has_header:
-            fh.readline()
-        block: List[List[float]] = []
-        for line in fh:
-            line = line.strip()
-            if not line:
-                continue
-            parts = line.split(delim) if delim else line.split()
-            block.append([_parse_float(p) for p in parts])
-            if len(block) >= chunk_rows:
-                yield np.asarray(block, np.float64)
-                block = []
-        if block:
-            yield np.asarray(block, np.float64)
+    yield from iter_raw_file_chunks(path, has_header, chunk_rows, delim)
 
 
 def two_round_load(path: str, max_bin: int = 255, min_data_in_bin: int = 3,

@@ -41,10 +41,19 @@ DESCRIPTIONS = {
     "is_pre_partition": "multi-machine: data files are pre-partitioned "
                         "per rank (no row sharding by the loader)",
     "is_enable_sparse": "kept for API compat (storage is dense+EFB)",
-    "enable_load_from_binary_file": "reuse <data>.bin when present",
+    "enable_load_from_binary_file": "reuse <data>.bin when present "
+                                    "(checksummed, memory-mapped; "
+                                    "skips parsing AND binning; a "
+                                    "cache whose fingerprint does not "
+                                    "match the data file + binning "
+                                    "params is refused)",
     "use_two_round_loading": "stream the file twice instead of holding "
-                             "raw values in memory",
-    "is_save_binary_file": "write <data>.bin after construction",
+                             "raw values in memory (subsumed by "
+                             "tpu_ingest, kept for the multi-process "
+                             "loader)",
+    "is_save_binary_file": "write <data>.bin after construction (v2 "
+                           "ingest cache: versioned + checksummed + "
+                           "source-fingerprinted)",
     "enable_bundle": "exclusive feature bundling (EFB)",
     "max_conflict_rate": "max fraction of conflicting rows per bundle",
     "has_header": "data files carry a header row",
@@ -79,6 +88,20 @@ DESCRIPTIONS = {
                                 "cross-rank metrics_aggregate.prom on "
                                 "rank 0) into tpu_telemetry_dir at end "
                                 "of run",
+    "tpu_ingest": "streaming ingest (lightgbm_tpu/ingest): build "
+                  "datasets by a chunked two-pass pipeline (pass 1 "
+                  "sketches bin bounds from a streamed row sample, "
+                  "pass 2 re-streams and bins against the frozen "
+                  "bounds) — bit-identical to in-memory construction "
+                  "at any chunk size; false restores the "
+                  "load-everything path",
+    "tpu_ingest_chunk_rows": "rows per streamed ingest chunk",
+    "tpu_ingest_device_shards": "land the binned matrix directly as "
+                                "per-device row shards under a "
+                                "single-process data/voting-parallel "
+                                "mesh (host blocks freed as they ship, "
+                                "so the dataset can exceed one "
+                                "device's HBM)",
     "is_predict_raw_score": "predict raw scores instead of transformed",
     "is_predict_leaf_index": "predict leaf indices per tree",
     "is_predict_contrib": "predict TreeSHAP feature contributions",
