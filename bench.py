@@ -4,7 +4,9 @@ Prints ONE JSON line per shape:
 {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
 Default (the driver's contract) runs the HIGGS-like headline shape only;
 set BENCH_SHAPE=epsilon|epsilon15|bosch|expo (or "all") to run the other
-reference benchmark shapes (docs/GPU-Performance.md:74-116: Epsilon
+reference benchmark shapes; BENCH_SHAPE=multichip runs the 1->2->4->8
+forced-host-device data-parallel scaling curve (Mrow-iters/s + per-pass
+comm elements per device count — the MULTICHIP_*.json trajectory) (docs/GPU-Performance.md:74-116: Epsilon
 400k x 2000 dense-wide, Bosch 1M x 968 sparse, Expo 11M x 700
 categorical; row counts here are scaled to CI-time runs and the metric is
 million row-iterations/sec, which is ~size-invariant).
@@ -534,14 +536,142 @@ def run_predict() -> list:
     return out
 
 
+def _multichip_child(n_devices: int) -> None:
+    """One device count of the scaling curve, in a FRESH process (the
+    forced host-device count only applies before backend init). Trains
+    the data-parallel learner (even at 1 device, so the curve is
+    apples-to-apples) and prints one JSON line with throughput + the
+    per-tree comm-elements the scatter schedule exists to shrink."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import lightgbm_tpu as lgb
+
+    rows = int(os.environ.get("BENCH_MULTICHIP_ROWS", 200_000))
+    iters = int(os.environ.get("BENCH_MULTICHIP_ITERS", 8))
+    reduce_mode = os.environ.get("BENCH_MULTICHIP_REDUCE", "scatter")
+    assert len(jax.devices()) >= n_devices
+    X, y = synth_higgs(rows, N_FEATURES)
+    params = {
+        "objective": "binary", "verbose": -1, "max_bin": MAX_BIN,
+        "num_leaves": 63, "learning_rate": 0.1, "min_data_in_leaf": 1,
+        "min_sum_hessian_in_leaf": 100.0, "tree_learner": "data",
+        "tpu_hist_reduce": reduce_mode,
+    }
+    ds = lgb.Dataset(X, y, params=dict(params))
+    ds.construct()
+    t0 = time.time()
+    lgb.train(dict(params), ds, num_boost_round=1, verbose_eval=False)
+    compile_s = time.time() - t0
+    t0 = time.time()
+    booster = lgb.train(dict(params), ds, num_boost_round=iters,
+                        verbose_eval=False)
+    booster.model_to_string()  # drain the pipeline before stopping the clock
+    wall = time.time() - t0
+    inner = booster._inner
+    plog = getattr(inner, "pass_log", None) or []
+    comm = (sum(p[3] for p in plog if len(p) > 3) / len(plog)) if plog \
+        else 0.0
+    passes = (sum(p[0] for p in plog) / len(plog)) if plog else 0.0
+    sched = getattr(inner, "_schedule_info", {})
+    print(json.dumps({
+        "n_devices": n_devices,
+        "mrow_iters_per_s": round(rows * iters / wall / 1e6, 4),
+        "wall_seconds": round(wall, 2),
+        "compile_seconds": round(compile_s, 2),
+        "rows": rows, "iters": iters,
+        "hist_reduce": sched.get("hist_reduce"),
+        "owned_groups": sched.get("owned_groups"),
+        "groups": sched.get("groups"),
+        "comm_elems_per_tree": round(comm),
+        "comm_elems_per_pass": round(comm / passes) if passes else 0,
+        "passes_per_tree": round(passes, 1),
+    }), flush=True)
+
+
+def run_multichip() -> list:
+    """Scaling curve (BENCH_SHAPE=multichip): the data-parallel learner
+    at 1 -> 2 -> 4 -> 8 forced host CPU devices, one child process per
+    device count, Mrow-iters/s + per-pass comm elements each. Feeds the
+    committed MULTICHIP_*.json trajectory so scaling regressions (and
+    the collective-volume economics of tpu_hist_reduce=scatter) are
+    visible round over round."""
+    import subprocess
+    import sys
+
+    counts = [int(d) for d in os.environ.get(
+        "BENCH_MULTICHIP_DEVICES", "1,2,4,8").replace(",", " ").split()]
+    per_dev = {}
+    out = []
+    for d in counts:
+        env = dict(os.environ)
+        env["BENCH_MULTICHIP_CHILD"] = str(d)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                            + f" --xla_force_host_platform_device_count={d}"
+                            ).strip()
+        try:
+            res = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                                 env=env, capture_output=True, text=True,
+                                 timeout=float(os.environ.get(
+                                     "BENCH_MULTICHIP_TIMEOUT", 1200)))
+            rc, out_text = res.returncode, res.stdout + res.stderr
+        except subprocess.TimeoutExpired as exc:
+            # one wedged device count must not abort the curve — the
+            # driver's contract is one JSON record per shape either way
+            rc = 124
+            out_text = "timeout: " + str(exc)
+        line = next((ln for ln in out_text.splitlines()
+                     if ln.startswith("{")), None)
+        if rc != 0 or line is None:
+            out.append({"metric": f"multichip_{d}dev_train_throughput",
+                        "value": None, "unit": "mrow_iters/s",
+                        "error": out_text[-400:]})
+            continue
+        rec = json.loads(line)
+        per_dev[d] = rec
+        out.append({
+            "metric": f"multichip_{d}dev_train_throughput",
+            "value": rec["mrow_iters_per_s"],
+            "unit": "mrow_iters/s",
+            "vs_baseline": 1.0,
+            "detail": rec,
+        })
+    base = per_dev.get(counts[0], {}).get("mrow_iters_per_s")
+    if base:
+        for d, rec in per_dev.items():
+            rec["speedup_vs_1dev"] = round(rec["mrow_iters_per_s"] / base, 3)
+        best = max(per_dev.values(), key=lambda r: r["mrow_iters_per_s"])
+        out.append({
+            "metric": "multichip_scaling_best_speedup",
+            "value": best.get("speedup_vs_1dev"),
+            "unit": "x_vs_1dev",
+            "vs_baseline": 1.0,
+            "detail": {"best_n_devices": best["n_devices"],
+                       "devices_measured": counts,
+                       "per_device": {str(d): per_dev[d] for d in per_dev}},
+        })
+    return out
+
+
 def main():
+    if os.environ.get("BENCH_MULTICHIP_CHILD"):
+        _multichip_child(int(os.environ["BENCH_MULTICHIP_CHILD"]))
+        return
     if os.environ.get("BENCH_INGEST_CHILD"):
         _ingest_child(os.environ["BENCH_INGEST_CHILD"],
                       os.environ["BENCH_INGEST_PATH"],
                       int(os.environ["BENCH_INGEST_ROWS"]))
         return
-    _init_backend_with_retry()
     which = os.environ.get("BENCH_SHAPE", "higgs")
+    if which == "multichip":
+        # the parent never touches a backend: each device count runs in
+        # a child pinned to the CPU platform (same rationale as the
+        # dryrun gate — a dead TPU relay must not hang the harness)
+        for entry in run_multichip():
+            print(json.dumps(entry), flush=True)
+        return
+    _init_backend_with_retry()
     if which == "amortized":
         print(json.dumps(run_amortized()), flush=True)
         return

@@ -341,18 +341,36 @@ class GBDT:
         # ingest may have landed the binned matrix as per-device row
         # shards already (ingest.ShardedLanding); reuse it when its
         # padding matches this plan, otherwise gather and re-pad
+        # the scatter-reduce data-parallel schedule pads the stored-group
+        # axis to a device multiple host-side (appended groups are empty
+        # columns no feature maps to) — decide it here so the device-landed
+        # reuse check and the grower see one consistent layout
+        hist_reduce = self.config.tree.tpu_hist_reduce
+        use_scatter = (self._tree_learner_kind == "data" and ndev > 1
+                       and hist_reduce == "scatter")
+        g_pad = (-(-int(train_data.num_groups) // ndev) * ndev
+                 if use_scatter else int(train_data.num_groups))
         device_binned = getattr(train_data, "device_binned", None)
         if device_binned is not None:
             usable = (int(device_binned.shape[0]) == n_pad and nproc == 1
                       and self._tree_learner_kind in ("data", "voting"))
+            if usable and g_pad > int(device_binned.shape[1]):
+                # scatter needs the stored-group axis padded to a device
+                # multiple; pad ON DEVICE (zero columns, row sharding
+                # preserved) instead of bouncing the landed shards
+                # through the host
+                device_binned = jnp.pad(
+                    device_binned,
+                    ((0, 0), (0, g_pad - int(device_binned.shape[1]))))
             if usable:
                 binned_host = None
             else:
                 log.warning(
                     "Device-landed dataset does not match the training "
-                    "layout (rows %d vs %d, learner %s); gathering to "
-                    "host and re-padding", int(device_binned.shape[0]),
-                    n_pad, self._tree_learner_kind)
+                    "layout (rows %d vs %d, learner %s, processes %d); "
+                    "gathering to host and re-padding",
+                    int(device_binned.shape[0]), n_pad,
+                    self._tree_learner_kind, nproc)
                 binned_host = _pad_to(
                     np.asarray(device_binned)[:n], n_pad)
                 device_binned = None
@@ -510,6 +528,13 @@ class GBDT:
         self._schedule_info = {
             "tree_learner": self._tree_learner_kind,
             "num_shards": int(ndev), "num_processes": int(nproc),
+            # data-parallel histogram-merge collective + per-device owned
+            # histogram slice (scatter: groups/ndev after padding; other
+            # schedules score the full group set everywhere)
+            "hist_reduce": (hist_reduce if use_scatter else "allreduce")
+            if self._tree_learner_kind == "data" else None,
+            "owned_groups": int(g_pad // ndev) if use_scatter
+            else int(g_cnt),
             "groups": int(g_cnt), "max_bin": int(self._max_bins),
             "wide": bool(wide), "subtract": bool(subtract),
             "compact": bool(compact), "compact_fraction": compact_frac,
@@ -534,11 +559,16 @@ class GBDT:
             min_data_in_leaf=self.config.tree.min_data_in_leaf,
             min_sum_hessian_in_leaf=self.config.tree.min_sum_hessian_in_leaf,
             max_depth=self.config.tree.max_depth,
+            # the scatter schedule pads the stored-group axis to a
+            # device multiple; the appended empty groups get 1-bin
+            # width-plan entries HERE (the single source — the binned
+            # matrices are padded to match below / in the grower prep)
             group_widths=tuple(
                 int(b) for b in (train_data.groups.group_num_bin
                                  if train_data.groups is not None
                                  and train_data.groups.num_groups
-                                 else train_data.num_bins_per_feature())),
+                                 else train_data.num_bins_per_feature()))
+            + (1,) * (g_pad - g_cnt if use_scatter else 0),
         )
 
         # build the distributed grower + finalize the (possibly feature-
@@ -570,7 +600,21 @@ class GBDT:
             else:
                 mesh = make_mesh(axis_name="data")
                 self._dist_grower = DataParallelGrower(
-                    mesh, self._grower_cfg, axis="data")
+                    mesh, self._grower_cfg, axis="data",
+                    hist_reduce=hist_reduce)
+                if self._dist_grower.cfg.hist_scatter \
+                        and binned_host is not None \
+                        and g_pad > binned_host.shape[1]:
+                    # pre-pad the stored-group axis ONCE here so the
+                    # grower's per-call prep sees an already-aligned
+                    # device-resident matrix (no host copy per
+                    # dispatch); the matching 1-bin width-plan entries
+                    # were appended at _grower_cfg construction above
+                    extra = g_pad - binned_host.shape[1]
+                    binned_host = np.concatenate(
+                        [binned_host,
+                         np.zeros((binned_host.shape[0], extra),
+                                  binned_host.dtype)], axis=1)
             log.info("Using %s-parallel tree learner over %d devices",
                      self._tree_learner_kind, ndev)
         if (self._tree_learner_kind == "feature"
@@ -822,6 +866,7 @@ class GBDT:
                     host_state = _HostState(jax.device_get(small))
                     tree = Tree.from_grower_state(host_state,
                                                   self.train_data)
+                self._log_pass_economics(host_state)
                 if tree.num_leaves > 1:
                     tree.apply_shrinkage(self.shrinkage_rate)
             else:
@@ -834,6 +879,7 @@ class GBDT:
                     host_state = _HostState(jax.device_get(small))
                     tree = Tree.from_grower_state(host_state,
                                                   self.train_data)
+                self._log_pass_economics(host_state)
                 if tree.num_leaves > 1:
                     tree.apply_shrinkage(self.shrinkage_rate)
                     # train score update via leaf ids (UpdateScore,
@@ -943,19 +989,27 @@ class GBDT:
                 tree.add_bias(self._pending_bias)
                 self._pending_bias = 0.0
                 self.init_score_bias = 0.0
-        # schedule observability (scripts/profile_train.py + PARITY.md):
-        # (passes, table high-water, rows fed to histogram contractions)
-        # per tree — the last entry is the compaction economics headline
-        # (full passes report ~passes * N)
+        self._log_pass_economics(host_state)
+        return tree
+
+    def _log_pass_economics(self, host_state) -> None:
+        """Schedule observability (scripts/profile_train.py + PARITY.md +
+        bench.py): append (passes, table high-water, rows fed to histogram
+        contractions, per-device collective elements) per tree —
+        rows_contracted is the compaction economics headline (full passes
+        report ~passes * N), comm_elems the histogram-merge volume the
+        scatter schedule exists to shrink."""
+        from .. import tracing
         if not hasattr(self, "pass_log"):
             self.pass_log = []
         rows_contracted = float(getattr(host_state, "rows_contracted", 0.0))
+        comm_elems = float(getattr(host_state, "comm_elems", 0.0))
         self.pass_log.append((int(host_state.num_passes),
                               int(host_state.next_free),
-                              rows_contracted))
+                              rows_contracted, comm_elems))
         tracing.counter("tree/num_passes", int(host_state.num_passes))
         tracing.counter("tree/rows_contracted", rows_contracted)
-        return tree
+        tracing.counter("tree/comm_elems", comm_elems)
 
     def _flush_pending(self) -> bool:
         """Materialize the pipelined tree, if any. Returns False when the

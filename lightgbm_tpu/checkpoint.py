@@ -61,6 +61,10 @@ _FINGERPRINT_EXCLUDE = {
     # construction produce identical datasets at any chunk size or
     # landing, tests/test_ingest.py) — a resumed run may change them
     "tpu_ingest", "tpu_ingest_chunk_rows", "tpu_ingest_device_shards",
+    # the histogram-merge collective is bit-transparent (scatter and
+    # allreduce grow bit-identical trees, tests/test_scatter_reduce.py)
+    # — a resumed run may switch schedules
+    "tpu_hist_reduce",
     "output_model", "output_result", "input_model", "convert_model",
     "config_file", "machine_list_file", "snapshot_freq", "verbose",
     "metric_freq", "num_iterations", "num_threads", "task",

@@ -107,9 +107,17 @@ class GrowerConfig(NamedTuple):
     Distributed axes (SURVEY.md §2.5, §3.5 — the reference's tree_learner
     matrix mapped onto a jax Mesh):
     - data_axis: mesh axis name over which ROWS are sharded. Histograms are
-      psum'd over it — the collective replacing Network::ReduceScatter +
+      reduced over it — the collective replacing Network::ReduceScatter +
       Allgather of HistogramBinEntry buffers (data_parallel_tree_learner
-      .cpp:148-163). All other state is computed redundantly per shard.
+      .cpp:148-163). With hist_scatter the reduction IS a ReduceScatter
+      (jax.lax.psum_scatter over the stored-group axis): each shard owns
+      groups/num_data_shards of the reduced histogram, scans splits only
+      for the features living in its owned slice, and the global best
+      travels through the same allreduce-argmax the feature-parallel path
+      uses — per-device collective bytes AND split-scan FLOPs both drop
+      ~num_data_shards x vs the full-psum schedule. Without hist_scatter
+      the full histogram is psum'd and every shard scores every feature
+      redundantly.
     - feature_axis: mesh axis name over which FEATURES are sharded (data
       replicated). Each shard builds histograms/splits only for its feature
       block; the global best split is an allreduce-argmax on (gain, payload)
@@ -149,6 +157,17 @@ class GrowerConfig(NamedTuple):
     voting: bool = False
     top_k: int = 20
     num_data_shards: int = 1
+    # ReduceScatter histogram merge (the reference data-parallel design,
+    # data_parallel_tree_learner.cpp:148-163): reduce histograms with
+    # psum_scatter over the stored-group axis so each data shard owns
+    # groups/num_data_shards of the result and scans splits only for the
+    # features in its owned slice (owned_feats table, built host-side by
+    # parallel.learners.DataParallelGrower — requires the group count to
+    # be padded to a shard multiple). The sibling-subtraction cache and
+    # all cached per-node histograms then live at owned-slice width too.
+    # Ignored for voting (which exchanges elected slices instead) and
+    # under feature parallelism.
+    hist_scatter: bool = False
     # static per-STORED-GROUP bin counts; the histogram kernels tile the
     # group axis into constant-row-chunk blocks scanned at each block's
     # own width (ops/histogram.plan_group_blocks). () = uniform max_bins.
@@ -356,6 +375,68 @@ def _leaf_best_split(hist, sum_g, sum_h, count, depth, feature_mask, fmeta, cfg)
             bcast(lg), bcast(lh), bcast(lc))
 
 
+def _scattered_best_split(hist, sum_g, sum_h, count, depth, feature_mask,
+                          fmeta, owned, gs, cfg):
+    """Owned-slice split finding for the ReduceScatter histogram schedule.
+
+    `hist` is this shard's REDUCED [Gl, B, 3] stored-group slice (groups
+    [gs, gs+Gl) of the global histogram, already summed over data shards
+    by psum_scatter); `owned` is the [Fl] table of global feature ids
+    whose stored group lives inside the slice (-1 padding — Fl is the max
+    owned-feature count over shards so every shard scans one static
+    shape). Each shard scans ONLY its owned features — the per-device
+    split-finding FLOPs drop ~num_data_shards x vs scoring all features
+    redundantly — and the winners merge through an allreduce-argmax with
+    ties broken toward the LOWEST global feature id, which is exactly the
+    serial argmax-over-[F] tie-break: scatter trees stay bit-identical to
+    the allreduce/serial schedules even on tied gains (the reference's
+    SyncUpGlobalBestSplit contract, parallel_tree_learner.h:184-207)."""
+    ok = owned >= 0
+    fidx = jnp.where(ok, owned, 0)
+    sub = {k: v[fidx] for k, v in fmeta.items()}
+    # rebase group ids into the owned slice; padded slots become 1-bin
+    # trivial features that can never split
+    sub["group"] = jnp.clip(sub["group"] - gs, 0, hist.shape[0] - 1)
+    sub["num_bin"] = jnp.where(ok, sub["num_bin"], 1)
+    fh = _extract_feature_hist(hist, sum_g, sum_h, count, sub, cfg)
+    res = split_ops.find_best_splits(
+        fh, sum_g, sum_h, count,
+        sub["num_bin"], sub["missing_type"], sub["default_bin"],
+        sub["is_categorical"],
+        lambda_l1=cfg.lambda_l1, lambda_l2=cfg.lambda_l2,
+        min_gain_to_split=cfg.min_gain_to_split,
+        min_data_in_leaf=cfg.min_data_in_leaf,
+        min_sum_hessian_in_leaf=cfg.min_sum_hessian_in_leaf)
+    gains = jnp.where(ok & feature_mask[fidx], res.gain, -jnp.inf)
+    if cfg.max_depth > 0:
+        gains = jnp.where(depth + 1 > cfg.max_depth, -jnp.inf, gains)
+    gains = jnp.minimum(gains, _GAIN_CLAMP)
+    # `owned` is ascending in global feature id, so argmax (first maximal
+    # position) is the shard's lowest-id winner
+    best = jnp.argmax(gains).astype(jnp.int32)
+    pick = lambda arr: arr[best]
+    gain = pick(gains)
+    feat_global = owned[best]
+
+    ax = cfg.data_axis
+    gmax = jax.lax.pmax(gain, ax)
+    win = (gain == gmax) & jnp.isfinite(gmax)
+    wfeat = jax.lax.pmin(jnp.where(win, feat_global, jnp.int32(1 << 30)),
+                         ax)
+    sel = win & (feat_global == wfeat)
+
+    def bcast(x):
+        xi = x.astype(jnp.int32) if x.dtype == jnp.bool_ else x
+        z = jnp.where(sel, xi, jnp.zeros_like(xi))
+        out = jax.lax.psum(z, ax)
+        return out > 0 if x.dtype == jnp.bool_ else out
+
+    return (gmax, bcast(jnp.maximum(feat_global, 0)),
+            bcast(pick(res.threshold)), bcast(pick(res.default_left)),
+            bcast(pick(res.is_categorical)), bcast(pick(res.left_sum_g)),
+            bcast(pick(res.left_sum_h)), bcast(pick(res.left_count)))
+
+
 def _voting_children_best(hists_local, sum_g, sum_h, count, depth,
                           feature_mask, fmeta, cfg):
     """Voting-parallel best splits for a batch of C children
@@ -505,7 +586,7 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
               fmeta_default_bin: jnp.ndarray, fmeta_is_cat: jnp.ndarray,
               fmeta_group: jnp.ndarray, fmeta_offset: jnp.ndarray,
               fmeta_is_bundled: jnp.ndarray,
-              cfg: GrowerConfig, n_valid=None):
+              cfg: GrowerConfig, n_valid=None, owned_feats=None):
     """Grow one leaf-wise tree.
 
     Args:
@@ -523,6 +604,10 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         layer bucket row counts into shared compiled signatures at ~zero
         padding cost. Under data_axis the per-shard count is derived from
         the shard's position (padding lives in the last shards).
+      owned_feats: [num_data_shards, Fl] i32 owned-feature table for the
+        hist_scatter schedule (-1 padding; each row ascending in global
+        feature id) — required when cfg.hist_scatter is active, ignored
+        otherwise. Built by parallel.learners.DataParallelGrower.
     Returns: TreeGrowerState — the host wraps the node arrays and converts
       bin thresholds to raw-space values.
     """
@@ -557,6 +642,31 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
 
     voting = cfg.voting and cfg.data_axis is not None
 
+    # ReduceScatter histogram schedule: reductions scatter over the
+    # stored-group axis, each shard keeping its owned [Gl, B, 3] slice
+    scatter = (cfg.hist_scatter and cfg.data_axis is not None
+               and not voting and cfg.feature_axis is None
+               and cfg.num_data_shards > 1)
+    if scatter:
+        if g_cols % cfg.num_data_shards != 0:
+            raise ValueError(
+                f"hist_scatter needs stored groups ({g_cols}) padded to a "
+                f"multiple of num_data_shards ({cfg.num_data_shards})")
+        if owned_feats is None:
+            raise ValueError("hist_scatter requires the owned_feats table")
+        gl = g_cols // cfg.num_data_shards
+        gs = jax.lax.axis_index(cfg.data_axis) * gl
+        # this shard's owned-feature row (the table rides replicated so
+        # the same call works single- and multi-process)
+        owned = jax.lax.dynamic_index_in_dim(
+            jnp.asarray(owned_feats, jnp.int32),
+            jax.lax.axis_index(cfg.data_axis), 0, keepdims=False)
+    else:
+        gl = fl
+    # width of the histogram slices this shard retains after reduction
+    # (the subtraction cache and all split scans live at this width)
+    own_g = gl if scatter else fl
+
     if n_valid is None:
         nv_local = None
     elif cfg.data_axis is not None:
@@ -567,12 +677,22 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     else:
         nv_local = jnp.minimum(n_valid, n)
 
-    def reduce_hist(h):
+    def reduce_hist(h, group_dim=0):
         """Data-axis reduction seam (the ReduceScatter of
-        data_parallel_tree_learner.cpp:148-163 — XLA picks the schedule).
-        Voting mode keeps histograms LOCAL; only elected slices travel."""
+        data_parallel_tree_learner.cpp:148-163). hist_scatter reduces
+        with an ACTUAL ReduceScatter over the stored-group axis (each
+        shard keeps only its owned slice — ~num_data_shards x fewer
+        collective bytes per device than the full psum, whose allgather
+        half replicates the whole tensor everywhere); otherwise a full
+        psum. Voting mode keeps histograms LOCAL; only elected slices
+        travel."""
         if cfg.data_axis is not None and not voting:
-            h = jax.lax.psum(h, cfg.data_axis)
+            if scatter:
+                h = jax.lax.psum_scatter(h, cfg.data_axis,
+                                         scatter_dimension=group_dim,
+                                         tiled=True)
+            else:
+                h = jax.lax.psum(h, cfg.data_axis)
         return h
 
     w3 = jnp.stack([grad * row_weight, hess * row_weight,
@@ -625,20 +745,35 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     pass_cap = 4 * L + 64   # == the round_cond hard pass cap
 
     # --- root (BeforeTrain: serial_tree_learner.cpp:234-323) ------------
-    root_hist = reduce_hist(
-        hist_ops.leaf_histogram(local_binned, w3, B, cfg.chunk,
-                                bf16=cfg.hist_bf16, n_valid=nv_local,
-                                group_widths=gw))
+    local_root = hist_ops.leaf_histogram(local_binned, w3, B, cfg.chunk,
+                                         bf16=cfg.hist_bf16, n_valid=nv_local,
+                                         group_widths=gw)
+    root_hist = reduce_hist(local_root)
     # global leaf sums: the reference Allreduces (cnt, sum_g, sum_h)
-    # (data_parallel_tree_learner.cpp:117-145); summing any feature's bins
-    # of the already-reduced histogram gives the same totals
-    root_tot = root_hist[0].sum(axis=0)
+    # (data_parallel_tree_learner.cpp:117-145); summing any group's bins
+    # gives the same totals. Voting keeps local histograms so it psums
+    # the LOCAL group-0 bin sums. Scatter reads the REDUCED group-0
+    # slice on its owning shard (shard 0, local index 0 — psum_scatter
+    # slices are bitwise equal to the full psum) and broadcasts, so the
+    # bin-sum ORDER matches the allreduce path exactly and totals stay
+    # bit-identical between the two schedules.
     if voting:
-        root_tot = jax.lax.psum(root_tot, cfg.data_axis)
+        root_tot = jax.lax.psum(local_root[0].sum(axis=0), cfg.data_axis)
+    elif scatter:
+        owner0 = jax.lax.axis_index(cfg.data_axis) == 0
+        root_tot = jax.lax.psum(
+            jnp.where(owner0, root_hist[0].sum(axis=0), 0.0),
+            cfg.data_axis)
+    else:
+        root_tot = root_hist[0].sum(axis=0)
     root_g, root_h, root_c = root_tot[0], root_tot[1], root_tot[2]
     root_comm = jnp.float32(0.0)
     if cfg.data_axis is not None:
-        root_comm = jnp.float32(3.0 if voting else fl * B * 3)
+        # per-device elements moved: voting ships 3 totals, scatter keeps
+        # one owned slice, the full psum replicates every group
+        root_comm = jnp.float32(3.0 if voting
+                                else (gl * B * 3 + 3 if scatter
+                                      else fl * B * 3))
 
     if voting:
         root_vals, comm1 = _voting_children_best(
@@ -646,6 +781,10 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             jnp.zeros(1, jnp.int32), local_fmask, local_fmeta, cfg)
         root_vals = tuple(v[0] for v in root_vals)
         root_comm = root_comm + comm1
+    elif scatter:
+        root_vals = _scattered_best_split(
+            root_hist, root_g, root_h, root_c, jnp.int32(0), local_fmask,
+            local_fmeta, owned, gs, cfg)
     else:
         root_vals = _leaf_best_split(
             root_hist, root_g, root_h, root_c, jnp.int32(0), local_fmask,
@@ -671,7 +810,10 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     )
 
     if subtract:
-        hist_cache = jnp.zeros((M, fl, B, 3), jnp.float32).at[0].set(root_hist)
+        # under hist_scatter the cache holds owned-slice histograms — the
+        # parent-minus-smaller identity is linear, so it holds slice-wise
+        hist_cache = jnp.zeros((M, own_g, B, 3),
+                               jnp.float32).at[0].set(root_hist)
     else:
         hist_cache = jnp.zeros((1,), jnp.float32)
 
@@ -881,7 +1023,11 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                 bf16=cfg.hist_bf16, n_valid=nv_local,
                 group_widths=gw)
             rows_pass = full_rows
-        hists = reduce_hist(hists)
+        # [C, G, B, 3]: the stored-group axis is dim 1
+        hists = reduce_hist(hists, group_dim=1)
+        # per-device elements kept from this reduction (C = K under
+        # subtraction — only the smaller children travel — else 2K)
+        red_c = hists.shape[0]
 
         if subtract:
             # larger child = parent - smaller (the cache holds every
@@ -911,10 +1057,16 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                 local_fmask, local_fmeta, cfg)
         else:
             if cfg.data_axis is not None:
-                comm = jnp.float32(2 * K * fl * B * 3)
-            split_fn = jax.vmap(
-                lambda h, g, hh, c, d: _leaf_best_split(
-                    h, g, hh, c, d, local_fmask, local_fmeta, cfg))
+                comm = jnp.float32(red_c * own_g * B * 3)
+            if scatter:
+                split_fn = jax.vmap(
+                    lambda h, g, hh, c, d: _scattered_best_split(
+                        h, g, hh, c, d, local_fmask, local_fmeta,
+                        owned, gs, cfg))
+            else:
+                split_fn = jax.vmap(
+                    lambda h, g, hh, c, d: _leaf_best_split(
+                        h, g, hh, c, d, local_fmask, local_fmeta, cfg))
             vals2 = split_fn(hists, all_g, all_h, all_c, all_d)
         gain2, feat2, thr2, dl2, cat2, lg2, lh2, lc2 = vals2
 
@@ -1143,6 +1295,8 @@ def schedule_summary(cfg: GrowerConfig) -> dict:
         "max_depth": int(cfg.max_depth),
         "data_axis": cfg.data_axis, "feature_axis": cfg.feature_axis,
         "voting": bool(cfg.voting),
+        "hist_scatter": bool(cfg.hist_scatter),
+        "num_data_shards": int(cfg.num_data_shards),
         "num_groups": len(widths),
         "group_width_max": int(max(widths)) if widths else int(cfg.max_bins),
     }

@@ -8,9 +8,16 @@ are obsolete — XLA chooses collective schedules over ICI/DCN; what remains
 of the reference design are the three SPMD seams (SURVEY.md §3.5):
 
   1. leaf sums       -> psum            (was Allreduce of 12-byte tuples)
-  2. histograms      -> psum            (was ReduceScatter + owned-feature
-                                         merge; XLA lowers psum to
-                                         reduce-scatter+all-gather itself)
+  2. histograms      -> psum_scatter over the stored-group axis
+                                        (hist_reduce=scatter, the default:
+                                         the reference's ReduceScatter +
+                                         owned-feature merge — each device
+                                         owns groups/D of the reduced
+                                         histogram and scans only its own
+                                         features) or full psum
+                                        (hist_reduce=allreduce: every
+                                         device scores every feature
+                                         redundantly)
   3. best split      -> pmax + masked psum broadcast (was allreduce with a
                                          custom argmax reducer)
 
@@ -65,17 +72,92 @@ def _pad_rows(n: int, multiple: int) -> int:
 
 
 class DataParallelGrower:
-    """Rows sharded over the mesh; histograms psum'd
-    (reference: DataParallelTreeLearner, data_parallel_tree_learner.cpp)."""
+    """Rows sharded over the mesh; histograms merged by ReduceScatter
+    (hist_reduce="scatter", the default — each device owns a stored-group
+    slice of the reduced histogram and finds splits only on its owned
+    features, the reference DataParallelTreeLearner design,
+    data_parallel_tree_learner.cpp:148-163) or by full Allreduce
+    (hist_reduce="allreduce" — every device scores every feature
+    redundantly, num_devices x more collective bytes per pass)."""
 
-    def __init__(self, mesh: Mesh, cfg: GrowerConfig, axis: str = "data"):
+    def __init__(self, mesh: Mesh, cfg: GrowerConfig, axis: str = "data",
+                 hist_reduce: str = "scatter"):
+        if hist_reduce not in ("scatter", "allreduce"):
+            log.fatal("hist_reduce must be 'scatter' or 'allreduce' "
+                      "(got %r)" % (hist_reduce,))
         self.mesh = mesh
         self.axis = axis
         self.nshards = mesh.shape[axis]
-        self.cfg = cfg._replace(data_axis=axis)
+        # a 1-shard mesh has nothing to scatter
+        self.hist_reduce = hist_reduce if self.nshards > 1 else "allreduce"
+        self.cfg = cfg._replace(
+            data_axis=axis, num_data_shards=self.nshards,
+            hist_scatter=self.hist_reduce == "scatter")
         self._global_binned = None
         self._global_binned_id = None
         self._calls = 0
+        # scatter prep cache: (id(binned) -> padded binned), owned table
+        self._scatter_binned = None
+        self._scatter_binned_id = None
+        self._owned_feats = None
+        self._owned_counted = False
+
+    # ------------------------------------------------------------------
+    # ReduceScatter host-side prep
+    # ------------------------------------------------------------------
+    def owned_feature_table(self, fmeta: Dict, num_groups: int):
+        """[nshards, Fl] table of global feature ids per owned group
+        slice (-1 padding, rows ascending in feature id — the scattered
+        argmax tie-break relies on the ordering, grow._scattered_best_
+        split). Shard s owns stored groups [s*Gl, (s+1)*Gl)."""
+        d = self.nshards
+        gp = -(-num_groups // d) * d
+        gl = gp // d
+        groups = np.asarray(fmeta["group"], np.int64)
+        owned = [np.nonzero((groups >= s * gl) & (groups < (s + 1) * gl))[0]
+                 for s in range(d)]
+        fl_max = max(1, max(len(o) for o in owned))
+        table = np.full((d, fl_max), -1, np.int32)
+        for s, o in enumerate(owned):
+            table[s, :len(o)] = o
+        return table, gp, gl
+
+    def _scatter_prep(self, binned, fmeta: Dict):
+        """Pad the stored-group axis to a shard multiple (appended groups
+        are all-bin-0 columns no feature maps to) and build the owned-
+        feature table; both cached — the padded matrix by input id, the
+        table for the grower's lifetime (feature->group layout is fixed
+        at dataset construction)."""
+        g = binned.shape[1]
+        if self._owned_feats is None:
+            table, gp, gl = self.owned_feature_table(fmeta, g)
+            self._owned_feats = jnp.asarray(table)
+            self._owned_groups = gl
+            widths = self.cfg.group_widths
+            if widths and len(widths) == g and gp != g:
+                self.cfg = self.cfg._replace(
+                    group_widths=widths + (1,) * (gp - g))
+            if not self._owned_counted:
+                telemetry.counter_add("parallel/owned_groups", gl)
+                telemetry.counter_add("parallel/owned_features",
+                                      int((table >= 0).sum(axis=1).max()))
+                self._owned_counted = True
+        d = self.nshards
+        gp = -(-g // d) * d
+        if gp == g:
+            return binned, self._owned_feats
+        if self._scatter_binned_id != id(binned):
+            arr = np.asarray(binned)
+            pad = np.zeros((arr.shape[0], gp - g), arr.dtype)
+            padded = np.concatenate([arr, pad], axis=1)
+            # keep the cached copy device-resident in single-process
+            # runs so repeat dispatches don't re-upload the matrix
+            # (multi-process shards stay host-side for the
+            # global_row_array assembly below)
+            self._scatter_binned = padded if jax.process_count() > 1 \
+                else jnp.asarray(padded)
+            self._scatter_binned_id = id(binned)
+        return self._scatter_binned, self._owned_feats
 
     def __call__(self, binned, grad, hess, row_weight, feature_mask,
                  fmeta: Dict, n_valid=None):
@@ -88,6 +170,9 @@ class DataParallelGrower:
         self._calls += 1
         telemetry.heartbeat(self._calls, phase="grower_dispatch")
         telemetry.counter_add("parallel/grower_calls", 1)
+        owned_feats = None
+        if self.cfg.hist_scatter:
+            binned, owned_feats = self._scatter_prep(binned, fmeta)
         cfg = self.cfg
         ax = self.axis
         # multi-host: inputs arrive as THIS PROCESS's row shard — assemble
@@ -121,15 +206,28 @@ class DataParallelGrower:
         # row count, so one shard_map signature serves both
         if n_valid is None:
             n_valid = binned.shape[0]
+        if owned_feats is None:
+            run = shard_map_compat(
+                lambda b, g, h, w, fm, nv, *meta: grow_tree(
+                    b, g, h, w, fm, *meta, cfg, n_valid=nv),
+                mesh=self.mesh,
+                in_specs=(P(ax, None), P(ax), P(ax), P(ax), P(None), P())
+                         + (P(None),) * 7,
+                out_specs=state_spec)
+            return run(binned, grad, hess, row_weight, feature_mask,
+                       jnp.int32(n_valid), *[fmeta[k] for k in FMETA_KEYS])
+        # scatter schedule: the owned-feature table rides replicated and
+        # each shard dynamic-indexes its own row (multihost-safe)
         run = shard_map_compat(
-            lambda b, g, h, w, fm, nv, *meta: grow_tree(
-                b, g, h, w, fm, *meta, cfg, n_valid=nv),
+            lambda b, g, h, w, fm, nv, of, *meta: grow_tree(
+                b, g, h, w, fm, *meta, cfg, n_valid=nv, owned_feats=of),
             mesh=self.mesh,
-            in_specs=(P(ax, None), P(ax), P(ax), P(ax), P(None), P())
-                     + (P(None),) * 7,
+            in_specs=(P(ax, None), P(ax), P(ax), P(ax), P(None), P(),
+                      P(None, None)) + (P(None),) * 7,
             out_specs=state_spec)
         return run(binned, grad, hess, row_weight, feature_mask,
-                   jnp.int32(n_valid), *[fmeta[k] for k in FMETA_KEYS])
+                   jnp.int32(n_valid), owned_feats,
+                   *[fmeta[k] for k in FMETA_KEYS])
 
     def _state_specs(self):
         from ..learner.grow import TreeGrowerState
@@ -211,7 +309,11 @@ class VotingParallelGrower(DataParallelGrower):
 
     def __init__(self, mesh: Mesh, cfg: GrowerConfig, axis: str = "data",
                  top_k: int = 20):
-        super().__init__(mesh, cfg, axis)
+        # voting's elected-slice exchange already moves O(top_k * B) per
+        # child — it keeps LOCAL histograms, so there is nothing for a
+        # ReduceScatter to merge (grow.py forces hist_scatter off under
+        # voting either way)
+        super().__init__(mesh, cfg, axis, hist_reduce="allreduce")
         self.cfg = self.cfg._replace(
             voting=True, top_k=max(1, top_k),
             num_data_shards=self.nshards)

@@ -196,6 +196,135 @@ def iter_parsed_chunks(path: str, has_header: bool = False,
     yield from iter_raw_file_chunks(path, has_header, chunk_rows, delim)
 
 
+def _exact_bin_sample(path: str, has_header: bool, chunk_rows: int,
+                      total_rows: int, sample_cnt: int, seed: int,
+                      kept_blocks: Optional[List[np.ndarray]],
+                      prepartition: bool = False):
+    """The serial `binning.sample_row_indices` sketch over a file stream:
+    returns (sample_rows [s, 1+F] float64, total_sample_cnt) — exactly
+    the rows the in-memory `find_bin_mappers` would sample, so the
+    derived bounds are bit-identical to serial construction
+    (ingest/sketch.py makes the same guarantee for the ingest path).
+
+    `kept_blocks` is the counting pass's retained raw stream when the
+    whole file fits the sample budget (then it IS the sample — no extra
+    parse). `prepartition` routes to the multi-process partition-sample
+    merge when a live distributed runtime spans multiple processes."""
+    from ..binning import sample_row_indices
+    from ..ingest.sketch import _RowGatherer
+
+    if prepartition:
+        live = False
+        probe_err = None
+        try:
+            import jax
+            # runtime-state probe, not jax.process_count() alone: that
+            # call would initialize a backend, which the parent process
+            # must avoid (same constraint as default_comm above)
+            from jax._src import distributed as _dist
+            live = (getattr(_dist.global_state, "client", None) is not None
+                    and jax.process_count() > 1)
+        except Exception as exc:  # private-API drift must be VISIBLE
+            probe_err = exc
+        if live:
+            return _prepartition_bin_sample(path, has_header, chunk_rows,
+                                            total_rows, sample_cnt, seed)
+        # pre-partitioned files without a live multi-process runtime:
+        # no channel to the other ranks exists — bounds are serial-exact
+        # for THIS partition only and may DIVERGE across ranks. Loud,
+        # because a silently-swallowed probe failure here would merge
+        # incompatible histograms later.
+        log.warning(
+            "Pre-partitioned multi-machine load without a live jax "
+            "distributed runtime%s: bin bounds are derived from this "
+            "rank's partition only and may diverge across ranks",
+            f" (runtime probe failed: {probe_err})" if probe_err else "")
+
+    idx = sample_row_indices(total_rows, sample_cnt, seed)
+    if idx is None:
+        # every row is the sample; the counting pass retained the stream
+        if kept_blocks:
+            return np.concatenate(kept_blocks, axis=0), total_rows
+        return np.zeros((0, 0), np.float64), total_rows
+    gather = _RowGatherer(idx)
+    lo = 0
+    ncols = 0
+    for block in iter_parsed_chunks(path, has_header, chunk_rows):
+        ncols = block.shape[1]
+        gather.feed(lo, block)
+        lo += len(block)
+    return gather.rows(ncols), int(len(idx))
+
+
+def _prepartition_bin_sample(path: str, has_header: bool, chunk_rows: int,
+                             local_rows: int, sample_cnt: int, seed: int):
+    """Exact bin sample when every rank holds a DIFFERENT file (its
+    pre-partitioned loader partition): the ranks agree on the sample of
+    the rank-concatenated VIRTUAL file — partition sizes are allgathered
+    to place each rank's global offset, each rank gathers the sampled
+    rows falling inside its slice, and the per-rank slices merge through
+    `multihost.allgather_bytes` in global-index order. Every rank lands
+    on one identical sample, bit-identical to a serial run over the
+    concatenated partitions."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+
+    from .multihost import allgather_bytes
+
+    counts = np.asarray(multihost_utils.process_allgather(
+        jnp.asarray(np.int64(local_rows)))).reshape(-1)
+    blob, total = _partition_sample_slice(
+        path, has_header, chunk_rows, counts, jax.process_index(),
+        sample_cnt, seed)
+    return _merge_sample_slices(allgather_bytes(blob)), total
+
+
+def _partition_sample_slice(path: str, has_header: bool, chunk_rows: int,
+                            counts: np.ndarray, rank: int,
+                            sample_cnt: int, seed: int):
+    """One rank's slice of the concatenated-file sample, packed for the
+    allgather: returns (blob, total_sample_cnt). Split from the comm
+    glue so the slice/merge logic is testable without a runtime."""
+    import io
+
+    from ..binning import sample_row_indices
+    from ..ingest.sketch import _RowGatherer
+
+    offsets = np.concatenate([[0], np.cumsum(counts)])
+    n_global = int(offsets[-1])
+    lo, hi = int(offsets[rank]), int(offsets[rank + 1])
+
+    idx = sample_row_indices(n_global, sample_cnt, seed)
+    mine_local = None if idx is None else \
+        (idx[(idx >= lo) & (idx < hi)] - lo).astype(np.int64)
+    gather = _RowGatherer(mine_local)
+    pos = 0
+    ncols = 0
+    for block in iter_parsed_chunks(path, has_header, chunk_rows):
+        ncols = block.shape[1]
+        gather.feed(pos, block)
+        pos += len(block)
+    rows = gather.rows(ncols)
+    gidx = (np.arange(hi - lo, dtype=np.int64) + lo) \
+        if mine_local is None else mine_local + lo
+
+    buf = io.BytesIO()
+    np.savez(buf, idx=gidx, rows=np.asarray(rows, np.float64))
+    total = n_global if idx is None else int(len(idx))
+    return buf.getvalue(), total
+
+
+def _merge_sample_slices(blobs) -> np.ndarray:
+    """Reassemble every rank's packed sample slice in global-index order
+    — the merged array IS the serial sample of the concatenated file."""
+    import io
+    parts = [np.load(io.BytesIO(b)) for b in blobs]
+    all_idx = np.concatenate([p["idx"] for p in parts])
+    all_rows = np.concatenate([p["rows"] for p in parts], axis=0)
+    return all_rows[np.argsort(all_idx, kind="stable")]
+
+
 def two_round_load(path: str, max_bin: int = 255, min_data_in_bin: int = 3,
                    bin_construct_sample_cnt: int = 200000,
                    has_header: bool = False, seed: int = 1,
@@ -209,14 +338,34 @@ def two_round_load(path: str, max_bin: int = 255, min_data_in_bin: int = 3,
                    sparse_threshold: float = 0.8):
     """Two-round file -> Dataset (use_two_round_loading,
     dataset_loader.cpp:193-207): round one streams the file once to count
-    rows and reservoir-sample for bin finding; round two streams again,
-    binning each chunk straight into per-feature uint8 columns. Peak
-    memory is O(sample + chunk * F * 8B + rows * F * 1B) instead of
-    O(rows * F * 8B)."""
+    rows, settle per-rank row ownership, and gather the EXACT
+    `binning.sample_row_indices` bin sample (the ingest sketch's
+    contract, ingest/sketch.py) — so the bin bounds every rank derives
+    are BIT-IDENTICAL to an in-memory/serial construction of the same
+    file, replacing the old per-rank reservoir whose bounds drifted with
+    rank count. Round two streams again, binning each chunk straight
+    into per-feature uint8 columns. Peak memory is O(sample + chunk * F
+    * 8B + rows * F * 1B) instead of O(rows * F * 8B).
+
+    Multi-process bound agreement: with a shared input file every rank
+    gathers the same global sample from its own stream — agreement is
+    structural. With pre-partitioned files (`shard_rows=False` under a
+    real multi-process runtime) each rank samples ITS loader partition's
+    slice of the rank-concatenated virtual file and the per-rank slices
+    merge through `multihost.allgather_bytes`, so all ranks still land
+    on one identical sample (bit-identical to a serial run over the
+    concatenated partitions). `comm` is kept for back-compat but the
+    mapper exchange it used to carry is gone — identical samples make
+    every rank derive identical mappers locally.
+
+    Files larger than `bin_construct_sample_cnt` rows pay one extra
+    parse pass to gather the exact sample (smaller files reuse the
+    counting pass's chunks) — the price of bit-exact multi-host bounds."""
     from ..dataset import Dataset as InnerDataset
     from ..efb import find_groups
 
-    # round 1: reservoir sample + per-rank row ownership
+    # round 1: row count + per-rank row ownership (+ opportunistic raw
+    # chunk retention while the stream still fits the sample budget)
     from ..io.parser import load_query_file
 
     shard = shard_rows and num_machines > 1
@@ -240,49 +389,50 @@ def two_round_load(path: str, max_bin: int = 255, min_data_in_bin: int = 3,
             return owner_row_global[global_lo:global_lo + n] == rank
         return stream.randint(0, num_machines, size=n) == rank
 
-    rng = np.random.RandomState(seed)
-    reservoir: List[np.ndarray] = []
-    seen = 0
     row_owner = np.random.RandomState(seed)  # same stream as partition_rows
     local_rows = 0
     owned_chunks: List[np.ndarray] = []
+    # raw chunks retained while the stream could still be <= the sample
+    # budget (then the whole file IS the serial sample and no extra
+    # gather pass is needed); dropped the moment the budget is exceeded
+    kept_blocks: Optional[List[np.ndarray]] = []
     global_lo = 0
     for block in iter_parsed_chunks(path, has_header, chunk_rows):
         mine = chunk_mine(global_lo, len(block), row_owner)
         if shard:
             owned_chunks.append(np.nonzero(mine)[0] + global_lo)
         global_lo += len(block)
-        local_block = block[mine]
-        local_rows += len(local_block)
-        for row in local_block:
-            seen += 1
-            if len(reservoir) < bin_construct_sample_cnt:
-                reservoir.append(row)
+        local_rows += int(mine.sum())
+        if kept_blocks is not None:
+            if global_lo <= bin_construct_sample_cnt:
+                kept_blocks.append(np.array(block, np.float64))
             else:
-                j = rng.randint(0, seen)
-                if j < bin_construct_sample_cnt:
-                    reservoir[j] = row
+                kept_blocks = None
     total_rows = global_lo
     if qsizes is not None and int(qsizes.sum()) != total_rows:
         log.fatal("Query file rows (%d) != data rows (%d)"
                   % (int(qsizes.sum()), total_rows))
-    if not reservoir:
+    if local_rows == 0:
         log.fatal("No rows for rank %d in %s" % (rank, path))
-    sample_full = np.asarray(reservoir)
+
+    # round 1.5: the exact serial bin sample (binning.sample_row_indices
+    # over the global stream). Identical samples on every rank make
+    # identical mappers without any mapper exchange — `comm` is accepted
+    # for back-compat but unused (the pre-partitioned path's row-slice
+    # merge rides multihost.allgather_bytes directly).
+    sample_full, total_sample = _exact_bin_sample(
+        path, has_header, chunk_rows, total_rows,
+        bin_construct_sample_cnt, seed, kept_blocks,
+        prepartition=not shard_rows and num_machines > 1)
+    del kept_blocks
     sample = np.delete(sample_full, label_column, axis=1)
+    del sample_full
     f = sample.shape[1]
-    # in a REAL multi-process run the mapper exchange must ride the
-    # distributed runtime — each rank's reservoir covers only its own
-    # rows, so without the allgather ranks would derive divergent bin
-    # boundaries and merge incompatible histograms
-    if comm is None:
-        comm = default_comm(num_machines)
-    mappers = find_bins_distributed(
-        sample, rank, num_machines, max_bin=max_bin,
-        min_data_in_bin=min_data_in_bin, total_sample_cnt=len(sample),
-        categorical_features=categorical_features,
-        use_missing=use_missing, zero_as_missing=zero_as_missing,
-        comm=comm)
+    from ..binning import mappers_from_sample
+    mappers = mappers_from_sample(
+        sample, total_sample, max_bin, min_data_in_bin, 0,
+        categorical_features, use_missing, zero_as_missing)
+    del sample
 
     # round 2: stream chunks into per-feature bin columns
     used = [j for j, m in enumerate(mappers) if not m.is_trivial]

@@ -13,7 +13,13 @@ with telemetry armed, and guarantees a diagnosis artifact either way:
   window to dump the partial snapshot), then SIGKILLs, and writes
   `MULTICHIP_dryrun.json` carrying rc, per-rank last-seen heartbeat
   (iteration/phase/age), the partial snapshot, and the stderr tail —
-  the "where did it die" evidence the next rc-124 needs.
+  the "where did it die" evidence the next rc-124 needs;
+- a C-level `faulthandler` handler rides the same SIGTERM (chained in
+  FRONT of the Python handler): even a rank wedged inside an XLA
+  compile/collective — where the Python-level handler can never run —
+  leaves its per-thread Python stacks in the artifact (r05's evidence
+  tail was a single JAX platform warning, useless for diagnosis; the
+  stack dump says which frame each rank was blocked in).
 
 Usage:
     python scripts/dryrun_multichip.py [n_devices] [--timeout SECONDS]
@@ -38,6 +44,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # child: the dryrun body with telemetry + graceful partial-dump handler
 # ---------------------------------------------------------------------------
 def child_main(n_devices: int, evidence_dir: str) -> int:
+    import faulthandler
+
     import jax
     # sitecustomize pins the platform via jax.config (ignores
     # JAX_PLATFORMS) — override in-process before any backend init
@@ -51,6 +59,7 @@ def child_main(n_devices: int, evidence_dir: str) -> int:
     if not os.environ.get("LGBM_TPU_HEARTBEAT_FILE"):
         telemetry.set_heartbeat_file(
             os.path.join(evidence_dir, f"heartbeat_r{rank}.json"))
+
 
     def dump_partial(signum=None, frame=None):
         snap = {
@@ -71,6 +80,17 @@ def child_main(n_devices: int, evidence_dir: str) -> int:
             os._exit(124)
 
     signal.signal(signal.SIGTERM, dump_partial)
+    # per-thread Python stacks on SIGTERM, written by faulthandler's
+    # C-LEVEL handler so they land even when this rank is wedged inside
+    # an XLA compile/collective where no Python bytecode (and hence no
+    # Python signal handler) can run. Registered AFTER signal.signal —
+    # faulthandler saves the handler installed at register time and
+    # `chain=True` forwards into it, so the partial-telemetry JSON dump
+    # still happens whenever Python is runnable.
+    stacks_fh = open(os.path.join(evidence_dir, f"stacks_r{rank}.txt"),
+                     "w")  # kept open: faulthandler dumps through the fd
+    faulthandler.register(signal.SIGTERM, file=stacks_fh,
+                          all_threads=True, chain=True)
 
     telemetry.heartbeat(0, phase="startup", rank=rank)
     import __graft_entry__ as g
@@ -99,6 +119,20 @@ def collect_evidence(evidence_dir: str) -> dict:
             "phase": hb.get("phase"),
             "age_seconds": round(now - float(hb.get("time", now)), 3),
         }
+    stacks = {}
+    for path in sorted(glob.glob(os.path.join(evidence_dir,
+                                              "stacks_r*.txt"))):
+        rank_id = os.path.basename(path)[len("stacks_r"):-len(".txt")]
+        try:
+            with open(path) as fh:
+                text = fh.read().strip()
+        except OSError:
+            continue
+        if text:
+            # per-rank per-thread Python frames at SIGTERM time — the
+            # "which frame was each rank blocked in" evidence; cap the
+            # copy so a huge thread dump can't bloat the artifact
+            stacks[rank_id] = text.splitlines()[-80:]
     partial = {}
     for path in sorted(glob.glob(os.path.join(evidence_dir,
                                               "partial_r*.json"))):
@@ -121,7 +155,8 @@ def collect_evidence(evidence_dir: str) -> dict:
                  snap.get("registry", {}).get("counters", [])
                  if c["name"] == "parallel/grower_calls"), 0),
         }
-    return {"ranks": ranks, "partial_telemetry": partial}
+    return {"ranks": ranks, "partial_telemetry": partial,
+            "sigterm_stacks": stacks}
 
 
 def run_watchdog(n_devices: int, timeout: float, out_path: str) -> int:

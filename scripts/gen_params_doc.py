@@ -171,6 +171,15 @@ DESCRIPTIONS = {
                              "compacted path (also sizes the gather "
                              "buffer; >= 1.0 forces compaction, <= 0 "
                              "disables it)",
+    "tpu_hist_reduce": "data-parallel histogram merge collective: "
+                       "scatter (default) ReduceScatters the histogram "
+                       "over the stored-group axis so each device owns "
+                       "groups/num_devices of the result and finds "
+                       "splits only on its owned features; allreduce "
+                       "restores the full-psum schedule (every device "
+                       "scores every feature). Trees are bit-identical "
+                       "either way; voting keeps its elected-slice "
+                       "exchange and ignores this",
     "tpu_hist_pallas": "retired; accepted for compatibility, warns and "
                        "uses the XLA path (see profiles/README.md "
                        "postmortem)",

@@ -85,6 +85,94 @@ def test_two_round_load_matches_in_memory(tmp_path):
     np.testing.assert_allclose(streamed.metadata.label, yp)
 
 
+def test_two_round_bounds_exact_when_sampled(tmp_path):
+    """n > bin_construct_sample_cnt: the streamed loader must land on the
+    EXACT `sample_row_indices` sketch — bin bounds bit-identical to the
+    in-memory construction with the same sample budget (the old
+    per-rank reservoir drifted here)."""
+    from lightgbm_tpu.binning import find_bin_mappers
+    from lightgbm_tpu.io.parser import load_data_file
+    rng = np.random.RandomState(9)
+    n, f, cnt = 3000, 4, 512
+    X = rng.randn(n, f)
+    X[rng.rand(n, f) < 0.1] = 0.0
+    y = X[:, 0]
+    path = str(tmp_path / "big.tsv")
+    np.savetxt(path, np.column_stack([y, X]), delimiter="\t", fmt="%.17g")
+
+    streamed = two_round_load(path, max_bin=31,
+                              bin_construct_sample_cnt=cnt,
+                              chunk_rows=170, seed=5)
+    Xp, _ = load_data_file(path)
+    serial = find_bin_mappers(Xp, max_bin=31, sample_cnt=cnt, seed=5)
+    assert len(streamed.mappers) == len(serial)
+    for a, b in zip(streamed.mappers, serial):
+        assert a.num_bin == b.num_bin
+        np.testing.assert_array_equal(
+            np.asarray(a.bin_upper_bound, np.float64),
+            np.asarray(b.bin_upper_bound, np.float64))
+
+
+def test_two_round_rank_sharded_bounds_agree_and_match_serial(tmp_path):
+    """Rank-sharded loading (shared file): every rank derives the SAME
+    mappers, bit-identical to the serial sketch — the distributed
+    bin-finding agreement that used to need a mapper exchange."""
+    from lightgbm_tpu.binning import find_bin_mappers
+    from lightgbm_tpu.io.parser import load_data_file
+    rng = np.random.RandomState(10)
+    n, f, cnt = 2200, 3, 400
+    X = rng.randn(n, f)
+    y = X[:, 1]
+    path = str(tmp_path / "shard.tsv")
+    np.savetxt(path, np.column_stack([y, X]), delimiter="\t", fmt="%.17g")
+
+    Xp, _ = load_data_file(path)
+    serial = find_bin_mappers(Xp, max_bin=15, sample_cnt=cnt, seed=1)
+    for r in range(3):
+        part = two_round_load(path, max_bin=15,
+                              bin_construct_sample_cnt=cnt,
+                              chunk_rows=256, rank=r, num_machines=3)
+        for a, b in zip(part.mappers, serial):
+            assert a.num_bin == b.num_bin
+            np.testing.assert_array_equal(
+                np.asarray(a.bin_upper_bound, np.float64),
+                np.asarray(b.bin_upper_bound, np.float64))
+
+
+def test_prepartition_sample_slices_merge_to_serial(tmp_path):
+    """The pre-partitioned-file sample exchange, minus the comm: each
+    rank's `_partition_sample_slice` blob merged by
+    `_merge_sample_slices` must equal the serial sketch of the
+    rank-concatenated file (the multihost.allgather_bytes path of
+    `_prepartition_bin_sample`, exercised without a jax runtime)."""
+    from lightgbm_tpu.binning import sample_row_indices
+    from lightgbm_tpu.parallel.loader import (_merge_sample_slices,
+                                              _partition_sample_slice)
+    rng = np.random.RandomState(12)
+    sizes = [700, 500, 300]
+    cnt = 256
+    parts = [rng.randn(s, 4) for s in sizes]
+    paths = []
+    for r, arr in enumerate(parts):
+        p = str(tmp_path / f"part{r}.tsv")
+        np.savetxt(p, arr, delimiter="\t", fmt="%.17g")
+        paths.append(p)
+    counts = np.asarray(sizes, np.int64)
+
+    blobs = []
+    for r, p in enumerate(paths):
+        blob, total = _partition_sample_slice(p, False, 128, counts, r,
+                                              cnt, seed=1)
+        assert total == cnt
+        blobs.append(blob)
+    merged = _merge_sample_slices(blobs)
+
+    full = np.vstack([np.loadtxt(p, delimiter="\t") for p in paths])
+    idx = sample_row_indices(len(full), cnt, seed=1)
+    np.testing.assert_allclose(merged, full[idx], rtol=1e-12)
+    assert merged.shape == (cnt, 4)
+
+
 def test_two_round_load_rank_sharding(tmp_path):
     rng = np.random.RandomState(3)
     n, f = 2000, 4

@@ -466,6 +466,73 @@ def test_four_process_voting_grower(tmp_path):
     assert int(states[0]["num_leaves_used"]) > 4
 
 
+PREPART_BIN_WORKER = r"""
+import io, os, sys
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, {repo!r})
+from lightgbm_tpu.parallel.multihost import init_distributed
+assert init_distributed()
+rank = jax.process_index()
+
+from lightgbm_tpu.parallel.loader import two_round_load
+inner = two_round_load({parts!r} + f"_rank{{rank}}.tsv", max_bin=15,
+                       bin_construct_sample_cnt={cnt}, chunk_rows=64,
+                       num_machines=2, rank=rank, shard_rows=False)
+np.savez({out!r} + f"_rank{{rank}}.npz",
+         num_bin=np.asarray([m.num_bin for m in inner.mappers]),
+         bounds=np.concatenate([np.asarray(m.bin_upper_bound, np.float64)
+                                for m in inner.mappers]))
+print("PREPART_OK", rank)
+"""
+
+
+def test_prepartition_bin_bounds_agree_via_allgather(tmp_path):
+    """Distributed bin finding over PRE-PARTITIONED files: each rank
+    samples its own loader partition's slice of the rank-concatenated
+    virtual file, the slices merge through multihost.allgather_bytes,
+    and every rank lands on bounds bit-identical to a serial sketch of
+    the concatenated data (parallel/loader._prepartition_bin_sample)."""
+    _require_multihost()
+    rng = np.random.RandomState(21)
+    n0, n1, f, cnt = 700, 500, 3, 256
+    parts = [rng.randn(n0, f + 1), rng.randn(n1, f + 1)]
+    parts_prefix = str(tmp_path / "part")
+    for r, arr in enumerate(parts):
+        np.savetxt(parts_prefix + f"_rank{r}.tsv", arr, delimiter="\t",
+                   fmt="%.17g")
+
+    port = _free_port()
+    out_prefix = str(tmp_path / "bounds")
+    script = PREPART_BIN_WORKER.format(repo=REPO, parts=parts_prefix,
+                                       out=out_prefix, cnt=cnt)
+    procs, outs = _run_ranks(script, nproc=2, devices_per_proc=1, port=port)
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out}"
+        assert f"PREPART_OK {rank}" in out
+
+    b0 = np.load(out_prefix + "_rank0.npz")
+    b1 = np.load(out_prefix + "_rank1.npz")
+    np.testing.assert_array_equal(b0["num_bin"], b1["num_bin"])
+    np.testing.assert_array_equal(b0["bounds"], b1["bounds"])
+
+    # ... and both equal the serial sketch of the CONCATENATED partitions
+    # (reparse through the same text round-trip the workers saw)
+    from lightgbm_tpu.binning import find_bin_mappers
+    from lightgbm_tpu.io.parser import load_data_file
+    full = np.concatenate(
+        [load_data_file(parts_prefix + f"_rank{r}.tsv")[0]
+         for r in range(2)], axis=0)
+    serial = find_bin_mappers(full, max_bin=15, sample_cnt=cnt, seed=1)
+    np.testing.assert_array_equal(
+        b0["num_bin"], np.asarray([m.num_bin for m in serial]))
+    np.testing.assert_array_equal(
+        b0["bounds"],
+        np.concatenate([np.asarray(m.bin_upper_bound, np.float64)
+                        for m in serial]))
+
+
 CLI_WORKER = r"""
 import os, sys
 import numpy as np

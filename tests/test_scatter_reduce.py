@@ -1,0 +1,137 @@
+"""ReduceScatter histogram-merge bit-identity sweep (ISSUE 9 tentpole).
+
+The data-parallel grower's `hist_reduce=scatter` schedule (psum_scatter
+over the stored-group axis + owned-slice split finding,
+learner/grow.py + parallel/learners.py) must produce trees BIT-IDENTICAL
+to the full-allreduce schedule — and structurally identical to the
+1-device serial grower — across the configs that touch the reduction
+seam differently: plain, bagging (zero-weight rows), sibling subtraction
+(the owned-slice histogram cache), subtraction+bagging, and the
+forced gather-compacted contraction.
+
+Each device count runs in a CHILD process (the in-process jax backend is
+already pinned to one CPU device; `--xla_force_host_platform_device_count`
+only applies before backend init). The serial 1-device reference is
+computed inside the same child, so one child covers the full
+1-vs-N comparison for its device count.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SWEEP_CHILD = r"""
+import sys
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, {repo!r})
+import jax.numpy as jnp
+from lightgbm_tpu.learner.grow import GrowerConfig, grow_tree, FMETA_KEYS
+from lightgbm_tpu.parallel import DataParallelGrower, make_mesh
+
+ndev = int(sys.argv[1])
+assert len(jax.devices()) >= ndev, (len(jax.devices()), ndev)
+
+N, F, B, L = 768, 6, 31, 15
+rng = np.random.RandomState(0)
+binned = (rng.rand(N, F) * B * rng.rand(F)[None, :]).astype(np.uint8) % B
+grad = (binned[:, 0] / 16.0 - 0.9 + 0.3 * rng.randn(N)).astype(np.float32)
+hess = np.ones(N, np.float32)
+bag = (rng.rand(N) < 0.7).astype(np.float32)
+fmeta = {{
+    "num_bin": np.full(F, B, np.int32),
+    "missing_type": np.zeros(F, np.int32),
+    "default_bin": np.zeros(F, np.int32),
+    "is_categorical": np.zeros(F, bool),
+    "group": np.arange(F, dtype=np.int32),
+    "offset": np.zeros(F, np.int32),
+    "is_bundled": np.zeros(F, bool),
+}}
+fmj = {{k: jnp.asarray(v) for k, v in fmeta.items()}}
+base = dict(num_leaves=L, max_bins=B, chunk=64, lambda_l1=0.0,
+            lambda_l2=0.0, min_gain_to_split=0.0, min_data_in_leaf=2,
+            min_sum_hessian_in_leaf=1e-3, max_depth=-1)
+# every config that exercises the reduction seam differently; compaction
+# is FORCED through the gathered kernel (compact_fraction >= 1.0)
+CONFIGS = {{
+    "plain": (dict(), np.ones(N, np.float32)),
+    "bagging": (dict(), bag),
+    "subtract": (dict(hist_subtract=True), np.ones(N, np.float32)),
+    "subtract_bag": (dict(hist_subtract=True), bag),
+    "compact": (dict(hist_compact=True, compact_fraction=1.0), bag),
+}}
+for name, (kw, rw) in CONFIGS.items():
+    cfg = GrowerConfig(**dict(base, **kw))
+    serial = grow_tree(jnp.asarray(binned), jnp.asarray(grad),
+                       jnp.asarray(hess), jnp.asarray(rw),
+                       jnp.ones(F, bool), *[fmj[k] for k in FMETA_KEYS],
+                       cfg)
+    states = {{}}
+    for mode in ("allreduce", "scatter"):
+        mesh = make_mesh(num_devices=ndev, axis_name="data")
+        grower = DataParallelGrower(mesh, cfg, axis="data",
+                                    hist_reduce=mode)
+        states[mode] = grower(jnp.asarray(binned), jnp.asarray(grad),
+                              jnp.asarray(hess), jnp.asarray(rw),
+                              jnp.ones(F, bool), fmeta)
+    a, s = states["allreduce"], states["scatter"]
+    # scatter vs allreduce: EVERY output field bitwise identical (comm
+    # accounting excepted — shrinking it is the schedule's point)
+    for k in a._fields:
+        if k == "comm_elems":
+            continue
+        np.testing.assert_array_equal(np.asarray(getattr(a, k)),
+                                      np.asarray(getattr(s, k)),
+                                      err_msg=f"{{name}}:{{k}}")
+    # ... and structurally identical to the 1-device serial tree
+    np.testing.assert_array_equal(np.asarray(s.node_feature),
+                                  np.asarray(serial.node_feature),
+                                  err_msg=name)
+    np.testing.assert_array_equal(np.asarray(s.node_threshold),
+                                  np.asarray(serial.node_threshold),
+                                  err_msg=name)
+    np.testing.assert_array_equal(np.asarray(s.leaf_id),
+                                  np.asarray(serial.leaf_id),
+                                  err_msg=name)
+    assert int(s.num_leaves_used) == int(serial.num_leaves_used) > 2
+    # the scatter schedule must actually move fewer elements
+    assert float(a.comm_elems) > float(s.comm_elems), name
+    print(name, "ratio", round(float(a.comm_elems)
+                               / float(s.comm_elems), 3))
+print("SWEEP_OK", ndev)
+"""
+
+
+def _run_sweep(ndev: int) -> str:
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + f" --xla_force_host_platform_device_count={ndev}"
+                        ).strip()
+    env["JAX_PLATFORMS"] = "cpu"
+    res = subprocess.run(
+        [sys.executable, "-c", SWEEP_CHILD.format(repo=REPO), str(ndev)],
+        env=env, capture_output=True, text=True, timeout=420)
+    assert res.returncode == 0, \
+        f"{ndev}-device sweep failed:\n{res.stdout}\n{res.stderr}"
+    assert f"SWEEP_OK {ndev}" in res.stdout
+    return res.stdout
+
+
+@pytest.mark.parametrize("ndev", [2, 4])
+def test_scatter_bitidentical_to_allreduce_and_serial(ndev):
+    """1 (in-child serial reference) vs {2, 4} forced host devices:
+    scatter == allreduce bitwise on every grower output, == serial on
+    structure, for plain/bagging/subtraction/compaction configs."""
+    out = _run_sweep(ndev)
+    # comm ratio floor: with F=6 groups padded to a device multiple the
+    # expected drop is F / ceil(F/ndev), i.e. 2x at 2 devices, 3x at 4
+    floor = 6 / -(-6 // ndev) - 0.01
+    ratios = [float(line.split()[-1]) for line in out.splitlines()
+              if line.split() and line.split()[0] in
+              ("plain", "bagging", "subtract", "subtract_bag", "compact")]
+    assert ratios and all(r >= floor for r in ratios), (ratios, floor)
