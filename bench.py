@@ -6,7 +6,10 @@ Default (the driver's contract) runs the HIGGS-like headline shape only;
 set BENCH_SHAPE=epsilon|epsilon15|bosch|expo (or "all") to run the other
 reference benchmark shapes; BENCH_SHAPE=multichip runs the 1->2->4->8
 forced-host-device data-parallel scaling curve (Mrow-iters/s + per-pass
-comm elements per device count — the MULTICHIP_*.json trajectory) (docs/GPU-Performance.md:74-116: Epsilon
+comm elements per device count — the MULTICHIP_*.json trajectory);
+BENCH_SHAPE=serve runs the serving-tier suite (quantized f32/f16/int8
+bulk throughput + open-loop sustained load with a mid-run hot swap +
+eviction probe, written to BENCH_SERVE_r07.json) (docs/GPU-Performance.md:74-116: Epsilon
 400k x 2000 dense-wide, Bosch 1M x 968 sparse, Expo 11M x 700
 categorical; row counts here are scaled to CI-time runs and the metric is
 million row-iterations/sec, which is ~size-invariant).
@@ -536,6 +539,232 @@ def run_predict() -> list:
     return out
 
 
+def run_serve() -> list:
+    """Serving-tier benchmarks (BENCH_SHAPE=serve) — the heavy-traffic
+    numbers the multi-tenant registry exists for:
+
+    (1) quantized bulk throughput: f32 vs f16 vs int8 Mrows/s through
+        the 500-tree serving stacks (accuracy gate at the default
+        tolerance — a lossy layout would abort the bench);
+    (2) open-loop sustained load against a ModelRegistry: Poisson
+        arrivals at a target QPS, mixed single-row submit() /
+        small-batch predict() traffic, one mid-run hot swap to a
+        freshly trained model — p50/p99 arrival-to-completion latency,
+        achieved QPS, and a zero-dropped-requests gate;
+    (3) eviction probe: two resident models under a deliberately tight
+        stack budget, proving budget enforcement stays correct (both
+        models keep serving bit-identical results while stacks churn).
+
+    Also writes the whole record to BENCH_SERVE_OUT (default
+    BENCH_SERVE_r07.json next to this file) so serving regressions are
+    tracked round-over-round like the training shapes."""
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.serving import ModelRegistry
+
+    trees = int(os.environ.get("BENCH_SERVE_TREES", 500))
+    train_rows = int(os.environ.get("BENCH_SERVE_TRAIN_ROWS", 6000))
+    bulk_rows = int(os.environ.get("BENCH_SERVE_ROWS", 262_144))
+    qps = float(os.environ.get("BENCH_SERVE_QPS", 200.0))
+    seconds = float(os.environ.get("BENCH_SERVE_SECONDS", 10.0))
+    serve_quant = os.environ.get("BENCH_SERVE_QUANTIZE", "f16")
+
+    X, y = synth_higgs(train_rows, N_FEATURES)
+    params = {
+        "objective": "binary", "verbose": -1, "max_bin": MAX_BIN,
+        "num_leaves": 31, "learning_rate": 0.1, "min_data_in_leaf": 1,
+        "min_sum_hessian_in_leaf": 100.0,
+    }
+    ds = lgb.Dataset(X, y, params=dict(params))
+    ds.construct()
+    t0 = time.time()
+    booster_a = lgb.train(dict(params), ds, num_boost_round=trees,
+                          verbose_eval=False)
+    train_s = time.time() - t0
+    swap_trees = max(20, trees // 10)
+    booster_b = lgb.train(dict(params), ds, num_boost_round=swap_trees,
+                          verbose_eval=False)
+    model_str = booster_a.model_to_string()
+    num_trees = booster_a.num_trees()
+
+    out = []
+    backend = "cpu-fallback" if os.environ.get("BENCH_CPU_CHILD") == "1" \
+        else "default"
+
+    # ---- (1) quantized bulk throughput ---------------------------------
+    Xb, _ = synth_higgs(bulk_rows, N_FEATURES, seed=7)
+    bulk = {}
+    for mode in ("none", "f16", "int8"):
+        b = lgb.Booster(model_str=model_str,
+                        params={"tpu_predict_quantize": mode})
+        predictor = b.serving_predictor(raw_score=True)
+        predictor.predict(Xb)        # compile + stack + accuracy gate
+        t0 = time.time()
+        predictor.predict(Xb)
+        wall = time.time() - t0
+        total_cap = b._inner.num_trees()
+        gate = b._inner._compiled_forest.gate_delta(
+            ("value", total_cap, 1, mode)) if mode != "none" else 0.0
+        bulk[mode] = {
+            "mrows_per_s": round(bulk_rows / wall / 1e6, 4),
+            "seconds": round(wall, 3),
+            "gate_delta": None if gate is None else round(gate, 8),
+        }
+    for mode, rec in bulk.items():
+        detail = {"rows": bulk_rows, "trees": num_trees,
+                  "backend": backend, "gate_delta": rec["gate_delta"],
+                  "train_seconds": round(train_s, 1)}
+        if mode != "none":
+            detail["speedup_vs_f32"] = round(
+                rec["mrows_per_s"] / max(bulk["none"]["mrows_per_s"], 1e-9),
+                3)
+        out.append({
+            "metric": "serve_bulk_throughput_%s"
+                      % ("f32" if mode == "none" else mode),
+            "value": rec["mrows_per_s"],
+            "unit": "mrows/s", "vs_baseline": 1.0, "detail": detail,
+        })
+
+    # ---- (2) open-loop sustained load + mid-run hot swap ---------------
+    rng = np.random.RandomState(11)
+    reg = ModelRegistry(warmup_rows=64)
+    # serve under the quantized layout the tier is built for
+    reg_a = lgb.Booster(model_str=model_str,
+                        params={"tpu_predict_quantize": serve_quant})
+    reg.publish("main", reg_a)
+    reg.submit("main", Xb[0]).result(timeout=60)   # settle the batcher
+
+    n_req = max(1, int(qps * seconds))
+    gaps = rng.exponential(1.0 / qps, size=n_req)
+    arrivals = np.cumsum(gaps)
+    is_batch = rng.rand(n_req) < 0.15
+    lat_lock = threading.Lock()
+    lats, dropped = [], [0]
+    pool = ThreadPoolExecutor(max_workers=8)
+    swap_at = arrivals[-1] / 2.0
+    swap_state = {"done": False, "wall": None, "published_at": None}
+
+    def record(arrival_abs, err=None):
+        dt = time.perf_counter() - arrival_abs
+        with lat_lock:
+            if err is None:
+                lats.append(dt)
+            else:
+                dropped[0] += 1
+
+    # the incoming version serves under the SAME quantized layout, so
+    # post-swap traffic measures the layout, not an f32 regression; the
+    # accuracy gate is settled on real rows BEFORE publishing (the
+    # operational pattern: validate the candidate on real data, then
+    # promote) so the mid-load swap measures swap mechanics, not the
+    # one-time calibration compile
+    swap_booster = lgb.Booster(model_str=booster_b.model_to_string(),
+                               params={"tpu_predict_quantize": serve_quant})
+    swap_booster.predict(Xb[:256], raw_score=True)
+
+    def do_swap():
+        t_sw = time.perf_counter()
+        reg.publish("main", swap_booster)
+        swap_state["wall"] = time.perf_counter() - t_sw
+        swap_state["published_at"] = time.perf_counter()
+
+    def do_batch(arrival_abs, lo):
+        try:
+            reg.predict("main", Xb[lo:lo + 8])
+            record(arrival_abs)
+        except Exception:
+            record(arrival_abs, err=True)
+
+    start = time.perf_counter()
+    for i in range(n_req):
+        target = start + arrivals[i]
+        now = time.perf_counter()
+        if target > now:
+            time.sleep(target - now)
+        if not swap_state["done"] and arrivals[i] >= swap_at:
+            swap_state["done"] = True
+            pool.submit(do_swap)
+        arrival_abs = time.perf_counter()
+        if is_batch[i]:
+            pool.submit(do_batch, arrival_abs, int(i * 8 % 4096))
+        else:
+            fut = reg.submit("main", Xb[i % 4096])
+            fut.add_done_callback(
+                lambda f, a=arrival_abs: record(a, err=f.exception()))
+    pool.shutdown(wait=True)
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        with lat_lock:
+            if len(lats) + dropped[0] >= n_req:
+                break
+        time.sleep(0.01)
+    wall = time.perf_counter() - start
+    reg_stats = reg.stats()
+    reg.close()
+
+    # snapshot under the lock: past the deadline, straggler callbacks
+    # may still be appending while we aggregate
+    with lat_lock:
+        done_lats = sorted(lats)
+        n_dropped = int(dropped[0])
+    p50 = done_lats[len(done_lats) // 2] if done_lats else None
+    p99 = done_lats[int(len(done_lats) * 0.99)] if done_lats else None
+    serve_rec = {
+        "metric": "serve_sustained_load",
+        "value": round(len(done_lats) / wall, 2),
+        "unit": "qps",
+        "vs_baseline": 1.0,
+        "detail": {
+            "backend": backend, "quantize": serve_quant,
+            "target_qps": qps, "seconds": round(wall, 2),
+            "requests": n_req, "completed": len(done_lats),
+            "dropped": n_dropped,
+            "batch_fraction": 0.15, "batch_rows": 8,
+            "p50_latency_ms": round(p50 * 1e3, 3) if p50 else None,
+            "p99_latency_ms": round(p99 * 1e3, 3) if p99 else None,
+            "hot_swap_wall_seconds": round(swap_state["wall"], 3)
+            if swap_state["wall"] else None,
+            "swaps": reg_stats["swaps"],
+            "trees_before_after": [num_trees, booster_b.num_trees()],
+        },
+    }
+    out.append(serve_rec)
+
+    # ---- (3) eviction probe under a tight budget -----------------------
+    small = lgb.Booster(model_str=booster_b.model_to_string())
+    reg2 = ModelRegistry(budget_mb=float(
+        os.environ.get("BENCH_SERVE_BUDGET_MB", 0.05)), warmup_rows=0)
+    reg2.publish("a", lgb.Booster(model_str=model_str))
+    reg2.publish("b", small)
+    probe = Xb[:64]
+    for _ in range(3):
+        reg2.predict("a", probe)
+        reg2.predict("b", probe)
+    ev_stats = reg2.stats()
+    reg2.close()
+    out.append({
+        "metric": "serve_eviction_probe",
+        "value": ev_stats["evictions"],
+        "unit": "evictions",
+        "vs_baseline": 1.0,
+        "detail": {"budget_bytes": ev_stats["budget_bytes"],
+                   "stack_bytes": ev_stats["stack_bytes"],
+                   "resident_models": ev_stats["resident_models"],
+                   "requests": ev_stats["requests"]},
+    })
+
+    out_path = os.environ.get(
+        "BENCH_SERVE_OUT", os.path.join(REPO, "BENCH_SERVE_r07.json"))
+    try:
+        with open(out_path, "w") as fh:
+            json.dump({"shape": "serve", "entries": out}, fh, indent=1)
+    except OSError:
+        pass
+    return out
+
+
 def _multichip_child(n_devices: int) -> None:
     """One device count of the scaling curve, in a FRESH process (the
     forced host-device count only applies before backend init). Trains
@@ -677,6 +906,10 @@ def main():
         return
     if which == "predict":
         for entry in run_predict():
+            print(json.dumps(entry), flush=True)
+        return
+    if which == "serve":
+        for entry in run_serve():
             print(json.dumps(entry), flush=True)
         return
     if which == "ingest":

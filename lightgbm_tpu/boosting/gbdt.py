@@ -75,6 +75,14 @@ def _jit_forest_leaf_raw(stacked, data):
     return _forest_jit("predict_forest_leaf_raw")(stacked, data)
 
 
+def _jit_forest_f16(mf, data):
+    return _forest_jit("predict_forest_f16")(mf, data)
+
+
+def _jit_forest_quant(qf, data):
+    return _forest_jit("predict_forest_quant")(qf, data)
+
+
 def _jit_forest_es(stacked_kt, data, margin, freq):
     """Margin-based early-stop forest walk (freq is static: it feeds a
     `t % freq` under the iteration while_loop; margin stays a traced
@@ -278,6 +286,10 @@ class GBDT:
         # so a cached stack can never outlive the model it was built from
         from ..serving.forest import CompiledForest
         self._compiled_forest = CompiledForest()
+        # publish hook (serving/registry.py): callbacks fired on every
+        # model-version bump, so a registry front end can track stack
+        # budgets / swap visibility without polling
+        self._version_listeners: List = []
 
     # ------------------------------------------------------------------
     def init(self, train_data: Dataset, objective: Optional[ObjectiveFunction],
@@ -1051,6 +1063,28 @@ class GBDT:
     # model. The version only ever increases.
     def _bump_model_version(self) -> None:
         self._compiled_forest.invalidate()
+        for listener in list(getattr(self, "_version_listeners", ())):
+            try:
+                listener(self._compiled_forest.version)
+            except Exception:  # a broken observer must not poison training
+                log.warning("model-version listener raised (ignored)")
+
+    def add_version_listener(self, fn) -> None:
+        """Publish hook: `fn(version)` fires after every ensemble
+        mutation (the registry uses it to refresh budget accounting and
+        swap-visibility gauges)."""
+        self._version_listeners.append(fn)
+
+    def remove_version_listener(self, fn) -> None:
+        try:
+            self._version_listeners.remove(fn)
+        except ValueError:
+            pass
+
+    def compiled_stack_bytes(self) -> int:
+        """Device bytes currently held by this booster's compiled
+        forest stacks (the registry's budget unit)."""
+        return self._compiled_forest.device_bytes()
 
     def model_version(self) -> int:
         """Monotonic counter identifying the current ensemble contents
@@ -1232,6 +1266,93 @@ class GBDT:
         c = int(self.config.io.tpu_predict_chunk)
         return c if c > 0 else default
 
+    # ------------------------------------------------------------------
+    # quantized serving layouts (tpu_predict_quantize, serving/forest.py)
+    # calibration rows for the accuracy-delta gate: enough to exercise
+    # every split region of a realistic forest without making the first
+    # quantized predict pay a second full-batch evaluation
+    _QUANT_CALIB_ROWS = 256
+
+    def _quantize_mode(self) -> str:
+        mode = str(self.config.io.tpu_predict_quantize or "none").lower()
+        from ..serving.forest import QUANTIZE_MODES
+        if mode not in QUANTIZE_MODES:  # config validates; double belt
+            raise log.LightGBMError(
+                "tpu_predict_quantize must be one of %s (got %r)"
+                % (QUANTIZE_MODES, mode))
+        return mode
+
+    def _class_stack_dev(self, entry, dj, mode):
+        """Dispatch one class's stacked forest on a padded chunk."""
+        if mode == "int8":
+            qf, st = entry
+            if qf is not None:
+                return _jit_forest_quant(qf, dj)
+            return _jit_forest_raw(st, dj) if st is not None else None
+        mf, st = entry
+        if mf is not None:
+            return _jit_forest_f16(mf, dj) if mode == "f16" \
+                else _jit_forest_raw_matmul(mf, dj)
+        return _jit_forest_raw(st, dj) if st is not None else None
+
+    def _quant_gate(self, cache, mode, k, total, q_stacks, data) -> None:
+        """Build-time accuracy gate: on the first predict of a freshly
+        stacked quantized layout, evaluate it AND the f32 stack on a
+        calibration batch (the head of the incoming data) and refuse to
+        serve if the worst raw-score delta exceeds
+        `tpu_predict_quantize_tol` (relative to the batch's raw-score
+        scale, floored at 1). The measured delta is cached per
+        (layout, model version), so steady-state requests only compare
+        a float against the tolerance — and a later call with a
+        tightened tolerance re-judges the same measurement instead of
+        re-running the comparison."""
+        import jax.numpy as jnp
+
+        from .. import tracing
+        from ..serving.forest import pad_rows
+        key = ("value", total, k, mode)
+        delta = cache.gate_delta(key)
+        if delta is None and getattr(self, "_quant_gate_defer", False):
+            # warmup traffic (synthetic all-zeros rows) must not become
+            # the cached calibration measurement — defer to the first
+            # real batch (serving/predictor.warmup sets the flag)
+            return
+        if delta is None:
+            n_cal = min(data.shape[0], self._QUANT_CALIB_ROWS)
+            calib = np.asarray(data[:n_cal], np.float32)
+            bucket = self._bucket_size(n_cal, self._PREDICT_ROW_CHUNK)
+            dj = jnp.asarray(pad_rows(calib, bucket))
+            f32_stacks = cache.value_stacks(self.models, k, total)
+            delta = 0.0
+            scale = 1.0
+            for cls in range(k):
+                fr = self._class_stack_dev(f32_stacks[cls], dj, "none")
+                qr = self._class_stack_dev(q_stacks[cls], dj, mode)
+                if fr is None or qr is None:
+                    continue
+                fr = np.asarray(fr, np.float64)[:n_cal]
+                qr = np.asarray(qr, np.float64)[:n_cal]
+                delta = max(delta, float(np.max(np.abs(fr - qr)))
+                            if n_cal else 0.0)
+                scale = max(scale, float(np.max(np.abs(fr)))
+                            if n_cal else 1.0)
+            delta = delta / scale
+            cache.record_gate(key, delta)
+            from .. import telemetry
+            telemetry.gauge_set("serving/quantize_gate_delta", delta)
+            tracing.counter("predict/quant_gate_runs", 1)
+            log.debug("Quantize gate (%s, %d trees): relative raw-score "
+                      "delta %.3g on %d calibration rows", mode, total,
+                      delta, n_cal)
+        tol = float(self.config.io.tpu_predict_quantize_tol)
+        if delta > tol:
+            raise log.LightGBMError(
+                "tpu_predict_quantize=%s refused: max raw-score delta "
+                "%.3g vs the f32 stack exceeds tpu_predict_quantize_tol"
+                "=%.3g (relative to the calibration batch's score "
+                "scale). Raise the tolerance or serve with "
+                "tpu_predict_quantize=none." % (mode, delta, tol))
+
     def _bucket_size(self, nrows: int, cap: int) -> int:
         from ..serving.forest import bucket_rows
         return bucket_rows(nrows, int(self.config.io.tpu_predict_bucket_min),
@@ -1297,6 +1418,10 @@ class GBDT:
                   and (k > 1 or (self.objective is not None
                                  and self.objective.name == "binary")))
         cache = self._forest_cache()
+        # quantized serving layouts (serving/forest.py): raw-score value
+        # prediction only — pred_leaf stays exact by contract and the
+        # early-stop route keeps its f32 [K, T] walk
+        mode = self._quantize_mode() if not use_es else "none"
         stacked_kt = None
         class_stacks = []
         if use_es:
@@ -1305,7 +1430,16 @@ class GBDT:
             # gather-free MXU path (ops/predict.MatmulForest), including
             # categorical models via the one-hot category expansion;
             # only over-budget forests take the walk
-            class_stacks = cache.value_stacks(self.models, k, total)
+            from ..ops.predict import QuantRefused
+            try:
+                class_stacks = cache.value_stacks(self.models, k, total,
+                                                  quantize=mode)
+            except QuantRefused as exc:
+                raise log.LightGBMError(
+                    "tpu_predict_quantize=%s refused for this model: %s"
+                    % (mode, exc)) from exc
+            if mode != "none" and n > 0:
+                self._quant_gate(cache, mode, k, total, class_stacks, data)
 
         c = self._predict_chunk_rows(
             self._PREDICT_ROW_CHUNK_MATMUL
@@ -1322,10 +1456,8 @@ class GBDT:
                                       float(pred_early_stop_margin),
                                       int(pred_early_stop_freq))
             devs = []
-            for mf, st in class_stacks:
-                raw = _jit_forest_raw_matmul(mf, dj) if mf is not None \
-                    else (_jit_forest_raw(st, dj) if st is not None
-                          else None)
+            for entry in class_stacks:
+                raw = self._class_stack_dev(entry, dj, mode)
                 if raw is not None and transform is not None:
                     # output transform fused on device: ONE f32 fetch
                     # instead of fetch-raw + re-upload + fetch-converted

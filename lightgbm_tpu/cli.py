@@ -304,6 +304,12 @@ def run_predict(params: Dict, cfg: Config) -> None:
         pred_early_stop=cfg.io.pred_early_stop,
         pred_early_stop_freq=cfg.io.pred_early_stop_freq,
         pred_early_stop_margin=cfg.io.pred_early_stop_margin)
+    if cfg.io.tpu_predict_quantize != "none":
+        # the accuracy-delta gate aborts (loudly) on the first batch if
+        # the quantized stacks drift past the tolerance
+        log.info("Serving with quantized forest layout '%s' (accuracy "
+                 "gate tolerance %g)", cfg.io.tpu_predict_quantize,
+                 cfg.io.tpu_predict_quantize_tol)
     result = predictor.predict(data)
     stats = predictor.stats()
     if stats.get("mean_latency_ms"):

@@ -192,6 +192,24 @@ class IOConfig:
     # double-buffered chunk loop: dispatch chunk k+1 before fetching
     # chunk k so H2D/compute/D2H overlap instead of serializing
     tpu_predict_pipeline: bool = True
+    # quantized device-resident forest layouts (serving/forest.py +
+    # ops/predict.py): "none" serves the bit-exact f32 stacks; "f16"
+    # stores leaf values f16 and the ±1 path/category tables bf16
+    # (split decisions stay bit-exact); "int8" additionally codes split
+    # thresholds fixed-point against the per-feature bound grids frozen
+    # at dataset build (8-bit code space) and evaluates with a single
+    # default-precision selection einsum. Applies to raw-score/value
+    # prediction; pred_leaf and prediction early stop keep exact f32
+    tpu_predict_quantize: str = "none"
+    # build-time accuracy gate for quantized layouts: max |raw-score
+    # delta| vs the f32 stack on a calibration batch, relative to the
+    # batch's score scale (floored at 1); a lossier layout raises
+    # instead of silently serving
+    tpu_predict_quantize_tol: float = 0.01
+    # serving.ModelRegistry device-memory budget for compiled stacks
+    # across all resident models, in MiB (0 = unlimited); the registry
+    # LRU-evicts idle models' stacks past it
+    tpu_serving_budget_mb: float = 0.0
     # Predictor.warmup() compiles bucket programs up to this many rows
     tpu_predict_warmup_rows: int = 4096
     # Predictor.submit() coalesces up to this many concurrent single-row
@@ -476,6 +494,19 @@ class Config:
         if self.tree.tpu_hist_reduce not in ("scatter", "allreduce"):
             log.fatal("tpu_hist_reduce must be 'scatter' or 'allreduce' "
                       "(got %r)" % (self.tree.tpu_hist_reduce,))
+        from .serving.forest import QUANTIZE_MODES
+        self.io.tpu_predict_quantize = \
+            str(self.io.tpu_predict_quantize).lower()
+        if self.io.tpu_predict_quantize not in QUANTIZE_MODES:
+            log.fatal("tpu_predict_quantize must be one of %s (got %r)"
+                      % ("/".join(QUANTIZE_MODES),
+                         self.io.tpu_predict_quantize))
+        if self.io.tpu_predict_quantize_tol <= 0:
+            log.fatal("tpu_predict_quantize_tol must be > 0 (got %r)"
+                      % (self.io.tpu_predict_quantize_tol,))
+        if self.io.tpu_serving_budget_mb < 0:
+            log.fatal("tpu_serving_budget_mb must be >= 0 (got %r)"
+                      % (self.io.tpu_serving_budget_mb,))
         if self.tree.histogram_pool_size >= 0 and self.tree_learner != "serial":
             log.warning("histogram_pool_size is only supported by serial "
                         "tree learner; ignoring")

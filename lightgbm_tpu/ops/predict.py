@@ -15,7 +15,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from ..binning import MISSING_NAN, MISSING_ZERO
+from ..binning import MISSING_NAN, MISSING_NONE, MISSING_ZERO
 
 K_ZERO_THRESHOLD = 1e-35
 
@@ -243,8 +243,12 @@ def predict_forest_binned(stacked: DeviceTree, binned: jnp.ndarray) -> jnp.ndarr
 
 
 def predict_forest_raw(stacked: DeviceTree, data: jnp.ndarray) -> jnp.ndarray:
+    # f32 cast before the cross-tree sum: quantized layouts store leaf
+    # values in f16 (see serving/forest.py) and a 500-term f16
+    # accumulation would drift ~1% — storage precision is the quantized
+    # contract, accumulation stays f32 (no-op for f32 forests)
     vals = jax.vmap(lambda tr: predict_value_raw(tr, data))(stacked)
-    return vals.sum(axis=0)
+    return vals.astype(jnp.float32).sum(axis=0)
 
 
 class MatmulForest(NamedTuple):
@@ -409,19 +413,25 @@ def _cat_expansion(mf: MatmulForest, nan_mask, clean):
     """[N, V] bf16 one-hot block expansion of the categorical columns
     (loop-invariant across trees — built once per dispatch). Out-of-range
     and NaN categories hit no block cell, so their table product is 0."""
-    v = mf.cat_table.shape[1]
+    return _cat_expansion_spec(mf.cat_table.shape[1], mf.cat_cols,
+                               mf.cat_off, mf.cat_card, nan_mask, clean)
+
+
+def _cat_expansion_spec(v, cat_cols, cat_off, cat_card, nan_mask, clean):
+    """_cat_expansion on a bare (V, cols, offsets, cards) spec — shared
+    by the MatmulForest and QuantForest layouts."""
     if v == 0:
         return None
     n = clean.shape[0]
-    fc = mf.cat_cols.shape[0]
-    vals = jnp.take(clean, mf.cat_cols, axis=1)           # [N, Fc]
-    nanv = jnp.take(nan_mask, mf.cat_cols, axis=1)
+    fc = cat_cols.shape[0]
+    vals = jnp.take(clean, cat_cols, axis=1)              # [N, Fc]
+    nanv = jnp.take(nan_mask, cat_cols, axis=1)
     iv = jnp.floor(vals).astype(jnp.int32)
-    ok = (~nanv) & (iv >= 0) & (iv < mf.cat_card[None, :])
+    ok = (~nanv) & (iv >= 0) & (iv < cat_card[None, :])
     # one scatter, O(N*Fc): invalid cells land in a per-feature parking
     # column beyond v (distinct per feature, so every (row, pos) index
     # is unique) and are sliced away
-    pos = jnp.where(ok, iv + mf.cat_off[None, :],
+    pos = jnp.where(ok, iv + cat_off[None, :],
                     v + jnp.arange(fc, dtype=jnp.int32)[None, :])
     rows = jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32)[:, None],
                             pos.shape)
@@ -473,12 +483,13 @@ def _one_tree_match(tree, nan_mask, clean, expanded=None):
 _FOREST_LEVEL_FIELDS = ("cat_cols", "cat_off", "cat_card")
 
 
-def _tree_batches(mf: MatmulForest, batch: int):
+def _tree_batches(mf, batch: int, forest_fields=_FOREST_LEVEL_FIELDS):
     """Reshape the per-tree fields [T, ...] -> [ceil(T/b), b, ...]
     (padding with zero trees: path == 0 everywhere makes S == 0 !=
     leaf_depth(-1) so padding trees match no leaf and contribute
-    nothing). Forest-level fields (the categorical expansion spec) are
-    nulled out — they are consumed outside the tree scan."""
+    nothing). Forest-level fields (the categorical expansion spec, and
+    the code grids of the QuantForest layout) are nulled out — they are
+    consumed outside the tree scan."""
     t = mf.feat.shape[0]
     nb = (t + batch - 1) // batch
     pad = nb * batch - t
@@ -488,7 +499,7 @@ def _tree_batches(mf: MatmulForest, batch: int):
             a = jnp.pad(a, ((0, pad),) + ((0, 0),) * (a.ndim - 1))
         return a.reshape((nb, batch) + a.shape[1:])
 
-    per_tree = mf._replace(**{f: None for f in _FOREST_LEVEL_FIELDS})
+    per_tree = mf._replace(**{f: None for f in forest_fields})
     padded = jax.tree.map(prep, per_tree)
     # padding leaf_depth must stay -1 (unmatchable), not 0
     if pad:
@@ -512,9 +523,10 @@ def predict_forest_raw_matmul(mf: MatmulForest, data: jnp.ndarray,
         def one(tree):
             match = _one_tree_match(tree, nan_mask, clean, expanded)
             # HIGHEST: one-hot x f32 leaf values stay exact (default
-            # bf16 inputs would truncate the leaf values)
+            # bf16 inputs would truncate the leaf values); the f32 cast
+            # upcasts f16-stored leaves of quantized layouts losslessly
             return jnp.einsum("nl,l->n", match.astype(jnp.float32),
-                              tree.leaf_value,
+                              tree.leaf_value.astype(jnp.float32),
                               preferred_element_type=jnp.float32,
                               precision=jax.lax.Precision.HIGHEST)
 
@@ -560,6 +572,292 @@ def predict_forest_leaf_raw(stacked: DeviceTree,
     one dispatch per tree)."""
     leaves = jax.vmap(lambda tr: predict_leaf_raw(tr, data))(stacked)
     return leaves.T.astype(jnp.int32)               # [N, T]
+
+
+class QuantForest(NamedTuple):
+    """MatmulForest variant with fixed-point (bin-code) split thresholds
+    and f16 leaf values (`tpu_predict_quantize=int8`).
+
+    Booster accelerators (arXiv:2011.02022 §3) observe that GBDT split
+    thresholds are bin boundaries frozen at dataset build, so a split
+    decision needs only the value's POSITION among the per-feature
+    bounds — an 8-bit code — not an f32 compare against an f32 value.
+    Rows are coded once per dispatch (`1 + #{bounds < x}` against the
+    per-feature grid, an elementwise pass amortized over every tree) and
+    each node stores the code of its own bound, so the layout evaluates
+    with ONE selection einsum per tree instead of MatmulForest's two
+    HIGHEST-precision passes (feature values + NaN mask) plus the
+    missing-logic chain:
+
+      fsel[N, M] = codes @ onehot(feat)   (integer codes ≤ 256 are exact
+                                           even in bf16 products — on
+                                           MXU hardware this runs at
+                                           default precision instead of
+                                           the 3x-pass HIGHEST f32 the
+                                           raw layout needs)
+      go_left    = (fsel ≤ thr_code) & (fsel ≥ lo)
+      S/match/value: unchanged from MatmulForest (bf16 path signature,
+                     f32 accumulation, f16 leaf values upcast at use)
+
+    Missing handling is folded into the codes: rows that are "missing"
+    at a feature (NaN under MissingType::NaN, NaN/±0 under Zero) code
+    to -1, and `lo` is -2 for default-left nodes / 0 for default-right
+    — so -1 passes the left test exactly when the node defaults left,
+    while real codes (≥ 1) never trip the lower bound. NaN under
+    MissingType::None codes as 0.0, reproducing _decide_raw's
+    fval_safe substitution. Split decisions are therefore BIT-EXACT vs
+    the f32 layouts (codes compare the same frozen f32 bounds); the
+    only lossy piece is the f16 leaf storage, which the build-time
+    accuracy gate (`tpu_predict_quantize_tol`, boosting/gbdt.py)
+    bounds. Categorical splits ride the same one-hot block expansion
+    and ±1 tables as MatmulForest, bf16-stored."""
+    # per-tree fields (names/shapes match MatmulForest so _tree_batches
+    # and the cat expansion are shared)
+    feat: jnp.ndarray           # [T, M] i32 original-column index
+    thr_code: jnp.ndarray       # [T, M] f32 fixed-point threshold code
+    lo: jnp.ndarray             # [T, M] f32 lower code bound (-2 dleft / 0)
+    path: jnp.ndarray           # [T, M, L] bf16 in {-1, 0, +1}
+    leaf_depth: jnp.ndarray     # [T, L] f32 (-1 for padding leaves)
+    leaf_value: jnp.ndarray     # [T, L] f16
+    is_cat: jnp.ndarray         # [T, M] bool
+    cat_table: jnp.ndarray      # [T, V, M] bf16 in {-1, 0, +1}
+    # forest-level fields (excluded from the per-tree batching)
+    grid: jnp.ndarray           # [F, K] f32 sorted bounds (+inf padded)
+    miss_nan: jnp.ndarray       # [F] bool feature MissingType == NaN
+    miss_zero: jnp.ndarray      # [F] bool feature MissingType == Zero
+    cat_cols: jnp.ndarray       # [Fc] i32 original column
+    cat_off: jnp.ndarray        # [Fc] i32 block offset into V
+    cat_card: jnp.ndarray       # [Fc] i32 block width
+
+
+_QUANT_FOREST_LEVEL_FIELDS = ("grid", "miss_nan", "miss_zero",
+                              "cat_cols", "cat_off", "cat_card")
+
+# max distinct thresholds per feature: the 8-bit code space (codes
+# 1..K+1 plus the -1 missing sentinel must stay distinguishable)
+QUANT_MAX_CODES = 255
+
+
+class QuantRefused(ValueError):
+    """Raised when a forest cannot be laid out fixed-point (more
+    distinct thresholds per feature than the 8-bit code space holds —
+    models binned past max_bin=256)."""
+
+
+def stack_trees_quant(trees):
+    """Build the QuantForest layout for one class's trees, or None when
+    the [T, M, L] path tensor / categorical expansion exceeds the
+    shared device-memory budgets (callers then fall back to the walk
+    layout with f16 leaves). Raises QuantRefused when any feature uses
+    more than QUANT_MAX_CODES distinct thresholds."""
+    import numpy as np
+    base = stack_trees_matmul(trees)
+
+    # per-feature threshold grids + missing types (missing type is a
+    # property of the FEATURE's bin mapper, identical across nodes)
+    fmax = np.finfo(np.float32).max
+    grids: dict = {}
+    miss: dict = {}
+    n_feat = 1
+    for t in trees:
+        for i in range(max(t.num_leaves - 1, 0)):
+            f = int(t.split_feature[i])
+            n_feat = max(n_feat, f + 1)
+            miss.setdefault(f, t.missing_type_node(i))
+            if t.is_categorical_node(i):
+                continue
+            thr = np.float32(np.clip(t.threshold[i], -fmax, fmax))
+            grids.setdefault(f, set()).add(float(thr))
+    k_grid = max([len(v) for v in grids.values()] or [1])
+    if k_grid > QUANT_MAX_CODES:
+        raise QuantRefused(
+            "int8 layout needs <= %d distinct split thresholds per "
+            "feature; this forest uses %d (trained with max_bin > 256?)"
+            % (QUANT_MAX_CODES, k_grid))
+    if base is None:
+        return None
+    grid = np.full((n_feat, k_grid), np.inf, np.float32)
+    sorted_grids = {}
+    for f, vals in grids.items():
+        sv = np.sort(np.asarray(list(vals), np.float32))
+        sorted_grids[f] = sv
+        grid[f, :len(sv)] = sv
+    miss_nan = np.zeros(n_feat, bool)
+    miss_zero = np.zeros(n_feat, bool)
+    for f, mt in miss.items():
+        miss_nan[f] = mt == MISSING_NAN
+        miss_zero[f] = mt == MISSING_ZERO
+
+    t_count, max_m = base.feat.shape
+    thr_code = np.zeros((t_count, max_m), np.float32)
+    lo = np.zeros((t_count, max_m), np.float32)
+    for ti, t in enumerate(trees):
+        for i in range(max(t.num_leaves - 1, 0)):
+            if t.is_categorical_node(i):
+                # decision comes from the cat table; park the code
+                # compare on "never left" so the is_cat select is the
+                # only voice (thr_code 0 < any real code)
+                thr_code[ti, i] = 0.0
+                lo[ti, i] = 0.0
+                continue
+            f = int(t.split_feature[i])
+            thr = np.float32(np.clip(t.threshold[i], -fmax, fmax))
+            thr_code[ti, i] = 1.0 + int(np.searchsorted(sorted_grids[f], thr))
+            lo[ti, i] = -2.0 if t.default_left_node(i) else 0.0
+
+    # numeric missing-typed splits are what the -1 sentinel exists for;
+    # without any, the coding pass skips special detection entirely
+    # (cat nodes resolve through the cat table, not the code compare)
+    has_special = any(
+        mt != MISSING_NONE for f, mt in miss.items()
+        if f in grids) if grids else False
+    return QuantForest(
+        feat=base.feat, thr_code=jnp.asarray(thr_code), lo=jnp.asarray(lo),
+        path=base.path.astype(jnp.bfloat16), leaf_depth=base.leaf_depth,
+        leaf_value=base.leaf_value.astype(jnp.float16),
+        is_cat=base.is_cat, cat_table=base.cat_table.astype(jnp.bfloat16),
+        grid=jnp.asarray(grid),
+        miss_nan=jnp.asarray(miss_nan) if has_special else None,
+        miss_zero=jnp.asarray(miss_zero) if has_special else None,
+        cat_cols=base.cat_cols,
+        cat_off=base.cat_off, cat_card=base.cat_card)
+
+
+def quant_codes(qf: QuantForest, data: jnp.ndarray):
+    """(codes[N, F], nan_mask, clean): the fixed-point coding pass.
+    Missing rows (per _decide_raw's per-feature missing type) code to
+    -1; NaN under MissingType::None codes as 0.0 (the fval_safe
+    substitution); everything else codes to 1 + #{bounds < x}, so
+    `code ≤ thr_code` reproduces `value ≤ bound` bit-exactly."""
+    nan_mask = jnp.isnan(data)
+    clean = jnp.where(nan_mask, 0.0, data)
+    n_feat = qf.grid.shape[0]
+    x = clean[:, :n_feat]
+    codes = 1.0 + (x[:, :, None] > qf.grid[None, :, :]).sum(
+        -1, dtype=jnp.int32).astype(jnp.float32)
+    if qf.miss_nan is not None:
+        # only forests that actually carry missing-typed numeric splits
+        # pay for the special-row detection (miss_nan is None otherwise)
+        is_nan = nan_mask[:, :n_feat]
+        special = ((qf.miss_nan[None, :] & is_nan)
+                   | (qf.miss_zero[None, :]
+                      & (is_nan | (jnp.abs(x) <= K_ZERO_THRESHOLD))))
+        codes = jnp.where(special, -1.0, codes)
+    if n_feat < data.shape[1]:
+        pad = jnp.ones((data.shape[0], data.shape[1] - n_feat), jnp.float32)
+        codes = jnp.concatenate([codes, pad], axis=1)
+    return codes, nan_mask, clean
+
+
+def _one_tree_match_quant(tree, codes, expanded=None):
+    """[N, L] exact one-hot leaf membership through the code-space
+    decision (tree = per-tree slice of a QuantForest)."""
+    f = codes.shape[1]
+    onehot = (jnp.arange(f, dtype=jnp.int32)[:, None]
+              == tree.feat[None, :]).astype(jnp.float32)     # [F, M]
+    # default precision: codes are integers ≤ 256 (exact in bf16
+    # products) and each reduction has exactly one nonzero term — no
+    # HIGHEST multi-pass needed, unlike the raw-value selection
+    fsel = jnp.einsum("nf,fm->nm", codes, onehot,
+                      preferred_element_type=jnp.float32)
+    go_left = (fsel <= tree.thr_code[None, :]) \
+        & (fsel >= tree.lo[None, :])
+    D = jnp.where(go_left, 1.0, -1.0).astype(jnp.bfloat16)   # [N, M]
+    if expanded is not None:
+        dcat = jnp.einsum("nv,vm->nm", expanded, tree.cat_table,
+                          preferred_element_type=jnp.float32)
+        dcat = jnp.where(dcat > 0.5, 1.0, -1.0).astype(jnp.bfloat16)
+        D = jnp.where(tree.is_cat[None, :], dcat, D)
+    S = jnp.einsum("nm,ml->nl", D, tree.path,
+                   preferred_element_type=jnp.float32)       # [N, L]
+    return S == tree.leaf_depth[None, :]
+
+
+def _leaf_value_reduce(match, leaf_value):
+    """[N] leaf-value pick from a one-hot [N, L] match via select+sum.
+
+    Numerically identical to the HIGHEST `match @ leaf_value` einsum
+    (the sum has exactly one nonzero term, and f32 adds of zeros are
+    exact) but measured 4x cheaper on the CPU backend, where the
+    match-cast einsum lowered to a scalar loop. The quantized layouts
+    use this form; the f32 layout keeps its frozen einsum kernel."""
+    return jnp.where(match, leaf_value[None, :].astype(jnp.float32),
+                     0.0).sum(-1)
+
+
+def predict_forest_quant(qf: QuantForest, data: jnp.ndarray,
+                         tree_batch: int = 10) -> jnp.ndarray:
+    """Sum of all trees' outputs per row through the fixed-point layout
+    (see QuantForest) — the same scanned tree-batch structure as
+    predict_forest_raw_matmul."""
+    codes, nan_mask, clean = quant_codes(qf, data)
+    expanded = _cat_expansion_spec(qf.cat_table.shape[1], qf.cat_cols,
+                                   qf.cat_off, qf.cat_card, nan_mask, clean)
+    batched = _tree_batches(qf, tree_batch,
+                            forest_fields=_QUANT_FOREST_LEVEL_FIELDS)
+
+    def body(acc, trees):
+        def one(tree):
+            match = _one_tree_match_quant(tree, codes, expanded)
+            return _leaf_value_reduce(match, tree.leaf_value)
+
+        return acc + jax.vmap(one)(trees).sum(axis=0), None
+
+    init = jnp.zeros(data.shape[0], jnp.float32)
+    out, _ = jax.lax.scan(body, init, batched)
+    return out
+
+
+def _one_tree_match_f16(tree, nan_mask, clean, expanded=None):
+    """_one_tree_match for the f16 layout: identical raw-space f32
+    threshold compares, but when the forest has no missing-typed
+    numeric splits (`tree.missing is None`, the common case for models
+    trained on NaN-free data) the NaN-mask selection einsum and the
+    missing-resolution chain are skipped — NaNs already behave as 0.0
+    through the `clean` substitution, exactly _decide_raw's
+    MissingType::None semantics."""
+    if tree.missing is not None:
+        return _one_tree_match(tree, nan_mask, clean, expanded)
+    f = clean.shape[1]
+    onehot = (jnp.arange(f, dtype=jnp.int32)[:, None]
+              == tree.feat[None, :]).astype(jnp.float32)      # [F, M]
+    fsel = jnp.einsum("nf,fm->nm", clean, onehot,
+                      preferred_element_type=jnp.float32,
+                      precision=jax.lax.Precision.HIGHEST)
+    D = jnp.where(fsel <= tree.threshold[None, :], 1.0, -1.0) \
+        .astype(jnp.bfloat16)                                 # [N, M]
+    if expanded is not None:
+        dcat = jnp.einsum("nv,vm->nm", expanded,
+                          tree.cat_table.astype(jnp.bfloat16),
+                          preferred_element_type=jnp.float32)
+        dcat = jnp.where(dcat > 0.5, 1.0, -1.0).astype(jnp.bfloat16)
+        D = jnp.where(tree.is_cat[None, :], dcat, D)
+    S = jnp.einsum("nm,ml->nl", D, tree.path.astype(jnp.bfloat16),
+                   preferred_element_type=jnp.float32)        # [N, L]
+    return S == tree.leaf_depth[None, :]
+
+
+def predict_forest_f16(mf: MatmulForest, data: jnp.ndarray,
+                       tree_batch: int = 10) -> jnp.ndarray:
+    """predict_forest_raw_matmul for the f16 quantized layout (f16 leaf
+    values, bf16 path/cat tables, `missing=None` when the forest has no
+    missing-typed numeric splits). Split decisions stay bit-exact; the
+    leaf-value reduction uses the select+sum form."""
+    nan_mask = jnp.isnan(data)
+    clean = jnp.where(nan_mask, 0.0, data)
+    expanded = _cat_expansion(mf, nan_mask, clean)
+    batched = _tree_batches(mf, tree_batch)
+
+    def body(acc, trees):
+        def one(tree):
+            match = _one_tree_match_f16(tree, nan_mask, clean, expanded)
+            return _leaf_value_reduce(match, tree.leaf_value)
+
+        return acc + jax.vmap(one)(trees).sum(axis=0), None
+
+    init = jnp.zeros(data.shape[0], jnp.float32)
+    out, _ = jax.lax.scan(body, init, batched)
+    return out
 
 
 def predict_forest_raw_early_stop(stacked_kt: DeviceTree, data: jnp.ndarray,

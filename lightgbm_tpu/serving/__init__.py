@@ -1,15 +1,23 @@
 """Serving-grade prediction engine.
 
 `CompiledForest` (forest.py) keeps the stacked/padded forest device-
-resident across `predict` calls with model-version invalidation;
-`Predictor` (predictor.py) is the request-facing front end: bucket-
-ladder warmup, a low-latency small-batch path, optional micro-batching
-of concurrent requests, and throughput/latency/cache counters. The
-reference analogue is `Predictor` (predictor.hpp:24-205), whose
-prediction closures are likewise built once per booster, not per call.
+resident across `predict` calls with model-version invalidation, in
+f32 and quantized (`tpu_predict_quantize=f16/int8`) layouts that
+coexist per model version; `Predictor` (predictor.py) is the
+request-facing front end: bucket-ladder warmup, a low-latency
+small-batch path, optional micro-batching of concurrent requests,
+row-width validation, and throughput/latency/cache counters;
+`ModelRegistry` (registry.py) serves many named boosters behind one
+front end with a shared device-memory budget (LRU stack eviction) and
+atomic zero-drop hot swap. The reference analogue is `Predictor`
+(predictor.hpp:24-205), whose prediction closures are likewise built
+once per booster, not per call; the registry/quantization tier follows
+the GBDT inference accelerator literature (arXiv:2011.02022).
 """
-from .forest import CompiledForest, bucket_ladder, bucket_rows, pad_rows
+from .forest import (QUANTIZE_MODES, CompiledForest, bucket_ladder,
+                     bucket_rows, pad_rows)
 from .predictor import Predictor
+from .registry import ModelRegistry
 
-__all__ = ["CompiledForest", "Predictor", "bucket_ladder", "bucket_rows",
-           "pad_rows"]
+__all__ = ["CompiledForest", "ModelRegistry", "Predictor",
+           "QUANTIZE_MODES", "bucket_ladder", "bucket_rows", "pad_rows"]

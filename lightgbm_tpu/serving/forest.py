@@ -15,6 +15,18 @@ continued training, checkpoint restore, model load, DART
 re-normalization), so a stale stack is structurally impossible: old
 versions can never be looked up again.
 
+Quantized layouts (`tpu_predict_quantize={f16,int8}`) are additional
+cache entries keyed by the quantize mode, so the f32 stack and its
+quantized siblings coexist per model version — the accuracy gate
+compares them on a calibration batch and the registry budgets them
+together. `f16` keeps the MatmulForest/DeviceTree algorithm with f16
+leaf values (+ bf16 path/category tables); `int8` is the fixed-point
+bin-code layout (`ops/predict.QuantForest`). Split decisions stay
+bit-exact in both; only the leaf-value storage is lossy, and
+`gate_delta` records the measured worst-case raw-score delta so
+`boosting/gbdt.py` can refuse a layout exceeding
+`tpu_predict_quantize_tol` instead of silently serving it.
+
 Shape buckets: `jax` compiles one program per input shape. Serving
 traffic has arbitrary batch sizes, so the row axis is padded up a
 power-of-two ladder (`bucket_rows`) — arbitrary sizes then hit a
@@ -42,6 +54,8 @@ _MAX_ENTRIES = 8
 # default power-of-two ladder floor: a single row pads to 16, which
 # costs nothing on a 128-lane machine and keeps the ladder short
 DEFAULT_BUCKET_MIN = 16
+
+QUANTIZE_MODES = ("none", "f16", "int8")
 
 
 def bucket_rows(n: int, bucket_min: int = DEFAULT_BUCKET_MIN,
@@ -83,6 +97,18 @@ def pad_rows(arr: np.ndarray, size: int) -> np.ndarray:
     return np.concatenate([arr, pad], axis=0)
 
 
+def _tree_bytes(value) -> int:
+    """Device bytes held by a cache entry (stacked NamedTuples, lists,
+    tuples — anything jax.tree can walk)."""
+    import jax
+    total = 0
+    for leaf in jax.tree.leaves(value):
+        nbytes = getattr(leaf, "nbytes", None)
+        if nbytes is not None:
+            total += int(nbytes)
+    return total
+
+
 class CompiledForest:
     """Per-booster cache of device-resident stacked forests.
 
@@ -91,11 +117,20 @@ class CompiledForest:
     the CURRENT version, so even an entry that somehow survived a clear
     could never be returned for a newer model. `enabled=False` (the
     `tpu_predict_cache=false` escape hatch) makes every lookup rebuild,
-    reproducing the per-call-restack seed behavior for A/B timing."""
+    reproducing the per-call-restack seed behavior for A/B timing.
+
+    `evict_entries()` drops the cached stacks WITHOUT bumping the model
+    version — the registry's device-memory budget reclaims idle models'
+    stacks this way; the next predict restacks from the host trees and
+    versioned lookups stay correct throughout."""
 
     def __init__(self):
         self._version = 0
         self._cache: "OrderedDict[Tuple, Any]" = OrderedDict()
+        self._entry_bytes: Dict[Tuple, int] = {}
+        # accuracy-gate ledger: (layout key) -> measured max raw-score
+        # delta vs the f32 stack on the calibration batch
+        self._gate_delta: Dict[Tuple, float] = {}
         self.enabled = True
         # the Predictor serves concurrent requests (micro-batcher thread
         # + caller threads); the lock covers lookup AND build so two
@@ -103,7 +138,8 @@ class CompiledForest:
         # (which would break the one-restack-per-version invariant)
         self._lock = threading.RLock()
         self.stats: Dict[str, int] = {
-            "restacks": 0, "hits": 0, "invalidations": 0}
+            "restacks": 0, "hits": 0, "invalidations": 0, "evictions": 0,
+            "bytes": 0}
 
     @property
     def version(self) -> int:
@@ -114,7 +150,28 @@ class CompiledForest:
             self._version += 1
             if self._cache:
                 self.stats["invalidations"] += 1
-            self._cache.clear()
+            self._drop_all()
+
+    def evict_entries(self) -> int:
+        """Drop every cached stack (registry memory reclaim; the model
+        version is NOT bumped). Returns the bytes freed."""
+        with self._lock:
+            freed = self.stats["bytes"]
+            if self._cache:
+                self.stats["evictions"] += 1
+            self._drop_all()
+            return freed
+
+    def _drop_all(self) -> None:
+        self._cache.clear()
+        self._entry_bytes.clear()
+        self._gate_delta.clear()
+        self.stats["bytes"] = 0
+
+    def device_bytes(self) -> int:
+        """Current device memory held by cached stacks."""
+        with self._lock:
+            return self.stats["bytes"]
 
     def _get(self, key: Tuple, build: Callable[[], Any]) -> Any:
         from .. import tracing
@@ -136,18 +193,59 @@ class CompiledForest:
             tracing.counter("serving/restack", 1)
             if self.enabled:
                 self._cache[key] = value
+                self._entry_bytes[key] = _tree_bytes(value)
+                self.stats["bytes"] += self._entry_bytes[key]
                 while len(self._cache) > _MAX_ENTRIES:
-                    self._cache.popitem(last=False)
+                    old_key, _ = self._cache.popitem(last=False)
+                    self.stats["bytes"] -= self._entry_bytes.pop(old_key, 0)
             return value
+
+    # ------------------------------------------------------------------
+    # accuracy-gate ledger (boosting/gbdt.py runs the comparison; the
+    # ledger lives here so it drops with the entries it describes)
+    def gate_delta(self, key: Tuple) -> Optional[float]:
+        with self._lock:
+            return self._gate_delta.get(key + (self._version,))
+
+    def record_gate(self, key: Tuple, delta: float) -> None:
+        with self._lock:
+            self._gate_delta[key + (self._version,)] = float(delta)
 
     # ------------------------------------------------------------------
     # stacked layouts (each build counts as ONE restack regardless of
     # class count — the unit the invalidation tests probe)
-    def value_stacks(self, models, k: int, total: int):
-        """Per-class [(MatmulForest|None, DeviceTree|None)] for raw-score
-        prediction (the layout choice of GBDT._predict_raw_matrix:
-        gather-free MXU path when the path tensor fits, walk
-        otherwise)."""
+    def value_stacks(self, models, k: int, total: int,
+                     quantize: str = "none"):
+        """Per-class stacks for raw-score prediction.
+
+        quantize="none": [(MatmulForest|None, DeviceTree|None)] — the
+        layout choice of GBDT._predict_raw_matrix (gather-free MXU path
+        when the path tensor fits, walk otherwise), bit-identical.
+        quantize="f16": same structure with f16 leaf values and bf16
+        path/category tables. quantize="int8": [QuantForest] fixed-point
+        layout (raises ops.predict.QuantRefused when the forest cannot
+        be coded). Distinct cache keys, so all three coexist."""
+        if quantize == "int8":
+            def build_q():
+                import jax.numpy as jnp
+
+                from ..ops.predict import stack_trees_quant, stack_trees_raw
+                stacks = []
+                for cls in range(k):
+                    class_trees = [models[i] for i in range(cls, total, k)]
+                    qf = stack_trees_quant(class_trees) \
+                        if class_trees else None
+                    st = None
+                    if class_trees and qf is None:
+                        # over the path/cat budgets: walk layout with
+                        # f16 leaves (same quantized-leaf contract)
+                        st = stack_trees_raw(class_trees)
+                        st = st._replace(
+                            leaf_value=st.leaf_value.astype(jnp.float16))
+                    stacks.append((qf, st))
+                return stacks
+            return self._get(("value", total, k, "int8"), build_q)
+
         def build():
             from ..ops.predict import stack_trees_matmul, stack_trees_raw
             stacks = []
@@ -157,13 +255,17 @@ class CompiledForest:
                 st = stack_trees_raw(class_trees) \
                     if class_trees and mf is None else None
                 stacks.append((mf, st))
+            if quantize == "f16":
+                return [_stacks_to_f16(mf, st) for mf, st in stacks]
             return stacks
-        return self._get(("value", total, k), build)
+        return self._get(("value", total, k, quantize), build)
 
     def leaf_stacks(self, models, total: int):
         """(MatmulForest|None, DeviceTree|None) over ALL trees for
         pred_leaf — the same cap/layout choice as the value path, so
-        both routes share one stacking implementation."""
+        both routes share one stacking implementation. Always f32:
+        leaf indices are exact by contract, quantize never routes
+        here."""
         def build():
             from ..ops.predict import stack_trees_matmul, stack_trees_raw
             mf = stack_trees_matmul(models[:total])
@@ -183,3 +285,27 @@ class CompiledForest:
                 lambda a: jnp.swapaxes(
                     a.reshape((t_iters, k) + a.shape[1:]), 0, 1), stacked)
         return self._get(("early_stop", t_iters, k), build)
+
+
+def _stacks_to_f16(mf, st):
+    """The f16 quantized layout: identical algorithm, leaf values
+    stored f16 (upcast to f32 inside the kernels before accumulation)
+    and the big ±1/0 tensors stored bf16 (exact — they hold only
+    -1/0/+1). Split thresholds stay f32: decisions remain bit-exact,
+    only leaf storage is lossy. When no NUMERIC node carries a missing
+    type, `missing` is nulled out so the eval kernel
+    (ops/predict.predict_forest_f16) skips the NaN-mask selection
+    einsum and missing-resolution chain outright — categorical nodes
+    resolve NaN through the block expansion regardless."""
+    import jax.numpy as jnp
+    if mf is not None:
+        numeric_missing = np.asarray(mf.missing)[~np.asarray(mf.is_cat)]
+        clean = not numeric_missing.any()
+        mf = mf._replace(
+            leaf_value=mf.leaf_value.astype(jnp.float16),
+            path=mf.path.astype(jnp.bfloat16),
+            cat_table=mf.cat_table.astype(jnp.bfloat16),
+            missing=None if clean else mf.missing)
+    if st is not None:
+        st = st._replace(leaf_value=st.leaf_value.astype(jnp.float16))
+    return (mf, st)

@@ -87,6 +87,19 @@ class Predictor:
     def num_features(self) -> int:
         return self._gbdt.max_feature_idx + 1
 
+    def _check_width(self, arr: np.ndarray) -> None:
+        """Reject wrong-width rows up front with a clear error — before
+        this check a mis-shaped row surfaced as an XLA shape failure at
+        the dispatch site AND burned a spurious retrace for a program
+        no valid request can ever reuse."""
+        want = self.num_features()
+        if arr.ndim != 2 or arr.shape[1] != want:
+            raise log.LightGBMError(
+                "Prediction input has %s feature column(s); this model "
+                "expects %d (shape %s)"
+                % (arr.shape[1] if arr.ndim == 2 else "a bad number of",
+                   want, tuple(arr.shape)))
+
     def warmup(self, max_rows: Optional[int] = None) -> Dict[str, Any]:
         """Compile every bucket program up to `max_rows` (default
         `tpu_predict_warmup_rows`) and stack the forest once, so the
@@ -98,8 +111,17 @@ class Predictor:
         ladder = bucket_ladder(int(io.tpu_predict_bucket_min), max(1, cap))
         f = self.num_features()
         t0 = time.perf_counter()
-        for rows in ladder:
-            self._predict_inner(np.zeros((rows, f), np.float32))
+        # synthetic all-zeros rows compile/stack fine but are useless —
+        # and dangerous — as quantize-gate calibration (16 identical
+        # rows traverse one leaf per tree, freezing a near-zero delta
+        # per model version): flag them so the gate defers to the first
+        # REAL batch
+        self._gbdt._quant_gate_defer = True
+        try:
+            for rows in ladder:
+                self._predict_inner(np.zeros((rows, f), np.float32))
+        finally:
+            self._gbdt._quant_gate_defer = False
         self._warmup_seconds = time.perf_counter() - t0
         self._warmup_buckets = ladder
         tracing.counter("serving/warmup_buckets", len(ladder))
@@ -126,6 +148,7 @@ class Predictor:
             else np.asarray(data, np.float32)
         if arr.ndim == 1:
             arr = arr.reshape(1, -1)
+        self._check_width(arr)
         t0 = time.perf_counter()
         out = self._gbdt.predict(arr, **kw)
         dt = time.perf_counter() - t0
@@ -152,6 +175,9 @@ class Predictor:
         predict_one; otherwise rows arriving within the window share
         one device dispatch."""
         arr = np.asarray(row, np.float32).reshape(-1)
+        # validate BEFORE enqueueing: a wrong-width row must fail its
+        # caller, not poison the whole coalesced batch it would ride in
+        self._check_width(arr.reshape(1, -1))
         fut: Future = Future()
         if self._micro_batch <= 0:
             try:
@@ -236,6 +262,7 @@ class Predictor:
         out["model_version"] = int(self._gbdt._compiled_forest.version)
         stack = self._gbdt._compiled_forest.stats
         out.update({f"stack_{k}": int(v) for k, v in stack.items()})
+        out["quantize"] = str(self._gbdt.config.io.tpu_predict_quantize)
         out["warmup_seconds"] = self._warmup_seconds
         out["warmup_buckets"] = list(self._warmup_buckets)
         if hist["count"]:
@@ -261,6 +288,8 @@ class Predictor:
         # cache hit/miss + latency mirrors for the file exporter
         telemetry.gauge_set("serving/stack_restacks", stack["restacks"])
         telemetry.gauge_set("serving/stack_hits", stack["hits"])
+        telemetry.gauge_set("serving/stack_bytes", stack["bytes"])
+        telemetry.gauge_set("serving/stack_evictions", stack["evictions"])
         telemetry.gauge_set("serving/model_version", out["model_version"])
         if hist["count"]:
             telemetry.gauge_set("serving/p99_latency_ms",

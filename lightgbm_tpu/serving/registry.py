@@ -1,0 +1,335 @@
+"""Multi-model serving registry: many boosters behind one front end.
+
+The heavy-traffic tier the ROADMAP's serving item names: a process
+serving millions of users runs MANY models (per-surface, per-cohort,
+canaries) on one accelerator, retrains them, and swaps new versions in
+without dropping traffic. The reference has no analogue (its Predictor
+is built once per booster per process); production GBDT servers grow
+exactly this shape around it.
+
+- **Registry**: named models, each behind its own `serving.Predictor`
+  (micro-batching, bucket-ladder warmup). Predictors share the compiled
+  bucket programs — the jit cache is keyed by stack/input shapes, so
+  same-shape models reuse each other's XLA programs and a swap compiles
+  nothing new.
+- **Device-memory budget**: compiled stacks across all resident models
+  are accounted against `tpu_serving_budget_mb` (`CompiledForest`
+  tracks per-entry bytes). Past budget, the least-recently-used models'
+  stacks are evicted — the HOST trees stay, so an evicted model's next
+  request restacks instead of failing, and versioned lookups stay
+  correct throughout (eviction never bumps the model version).
+- **Atomic hot swap**: `publish(name, booster)` warms the incoming
+  predictor over the bucket ladder FIRST, swaps the entry under the
+  registry lock, then drains the outgoing predictor's micro-batch
+  queue. In-flight `submit()` futures complete on the model they were
+  accepted under; requests racing the swap retry onto the new entry —
+  zero dropped, zero misrouted (gated by
+  scripts/predict_latency_smoke.py and the sustained-load bench).
+- **Telemetry**: resident-model count, stack bytes vs budget, eviction
+  and publish counts, and per-model request counters are mirrored into
+  `serving/registry_*` gauges on the hot paths themselves, so the
+  Prometheus export carries the tier without a stats() caller in the
+  loop.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Dict, Optional
+
+from .. import log, telemetry
+from .predictor import Predictor
+
+
+class _Entry:
+    __slots__ = ("name", "booster", "gbdt", "predictor", "publish_version",
+                 "requests", "published_at", "listener")
+
+    def __init__(self, name, booster, gbdt, predictor, publish_version):
+        self.name = name
+        self.booster = booster
+        self.gbdt = gbdt
+        self.predictor = predictor
+        self.publish_version = publish_version
+        self.requests = 0
+        self.published_at = time.time()
+        self.listener = None
+
+
+class ModelRegistry:
+    """Named boosters behind one serving front end with a shared
+    device-memory budget and atomic hot swap.
+
+    `budget_mb` overrides `tpu_serving_budget_mb` (0 = unlimited).
+    `predictor_kwargs` fix the per-model Predictor defaults
+    (num_iteration, raw_score, ...). `warmup_rows` caps the publish-time
+    bucket-ladder warmup (None = each model's
+    `tpu_predict_warmup_rows`; 0 skips warmup)."""
+
+    def __init__(self, budget_mb: Optional[float] = None,
+                 warmup_rows: Optional[int] = None,
+                 **predictor_kwargs):
+        self._lock = threading.RLock()
+        self._models: "OrderedDict[str, _Entry]" = OrderedDict()
+        self._budget_mb = budget_mb
+        self._warmup_rows = warmup_rows
+        self._predictor_kwargs = dict(predictor_kwargs)
+        self._closed = False
+        # budget recomputed on publish/unpublish, read per request: the
+        # no-budget default must cost nothing on the submit hot path
+        self._budget_cached = 0
+        self.stats_counts: Dict[str, int] = {
+            "publishes": 0, "swaps": 0, "evictions": 0, "requests": 0}
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _gbdt_of(booster):
+        return getattr(booster, "_inner", booster)
+
+    def _compute_budget_bytes(self) -> int:
+        if self._budget_mb is not None:
+            return int(self._budget_mb * (1 << 20))
+        for entry in self._models.values():
+            mb = float(entry.gbdt.config.io.tpu_serving_budget_mb)
+            if mb > 0:
+                return int(mb * (1 << 20))
+        return 0
+
+    def _budget_bytes(self) -> int:
+        return self._budget_cached
+
+    # ------------------------------------------------------------------
+    def publish(self, name: str, booster, warmup_rows: Optional[int] = None
+                ) -> Dict[str, Any]:
+        """Atomically (re)bind `name` to `booster`. Returns the publish
+        record (per-name publish version + the booster's model version).
+
+        The incoming predictor is warmed BEFORE the swap so already-seen
+        bucket shapes compile nothing afterwards; the outgoing
+        predictor's micro-batch queue is drained after the swap, so
+        every accepted future resolves on the model it was accepted
+        under. Publishing the same booster again is a cheap no-op swap
+        (fresh publish version, same stacks)."""
+        with self._lock:
+            if self._closed:
+                raise log.LightGBMError("ModelRegistry is closed")
+        gbdt = self._gbdt_of(booster)
+        predictor = Predictor(booster, **self._predictor_kwargs)
+        rows = warmup_rows if warmup_rows is not None else self._warmup_rows
+        if rows != 0:
+            predictor.warmup(max_rows=rows)
+
+        def _on_version(_v, _name=name):
+            # publish hook (boosting/gbdt.py): keep budget/visibility
+            # gauges fresh when the resident model itself mutates
+            # (continued training on a published booster)
+            self._mirror_gauges()
+
+        old = None
+        with self._lock:
+            if self._closed:
+                # close() ran while we warmed up: do not resurrect a
+                # model into a closed registry
+                predictor.close()
+                raise log.LightGBMError("ModelRegistry is closed")
+            prev = self._models.pop(name, None)
+            version = (prev.publish_version + 1) if prev else 1
+            entry = _Entry(name, booster, gbdt, predictor, version)
+            entry.listener = _on_version
+            # listener registered BEFORE the entry becomes visible: a
+            # racing publish/unpublish of the same name can then always
+            # pair its remove_version_listener with this add
+            gbdt.add_version_listener(_on_version)
+            self._models[name] = entry          # most-recently-used end
+            self._budget_cached = self._compute_budget_bytes()
+            self.stats_counts["publishes"] += 1
+            if prev is not None:
+                self.stats_counts["swaps"] += 1
+                old = prev
+        if old is not None:
+            if old.listener is not None:
+                old.gbdt.remove_version_listener(old.listener)
+            # drain outside the lock: new requests already route to the
+            # new entry; accepted futures on the old one complete here
+            old.predictor.close()
+        record = {"name": name, "publish_version": version,
+                  "model_version": gbdt.model_version(),
+                  "warmed_buckets": list(predictor._warmup_buckets)}
+        telemetry.counter_add("serving/registry_publishes", 1)
+        self._enforce_budget()
+        self._mirror_gauges()
+        log.debug("Registry published %s v%d (model version %d)", name,
+                  version, record["model_version"])
+        return record
+
+    def unpublish(self, name: str) -> bool:
+        """Remove a model (drains its predictor). Returns False when
+        absent."""
+        with self._lock:
+            entry = self._models.pop(name, None)
+            self._budget_cached = self._compute_budget_bytes()
+        if entry is None:
+            return False
+        if entry.listener is not None:
+            entry.gbdt.remove_version_listener(entry.listener)
+        entry.predictor.close()
+        self._mirror_gauges()
+        return True
+
+    def models(self):
+        with self._lock:
+            return list(self._models)
+
+    def _entry(self, name: str) -> _Entry:
+        with self._lock:
+            entry = self._models.get(name)
+            if entry is None:
+                raise log.LightGBMError(
+                    "Model %r is not published (resident: %s)"
+                    % (name, list(self._models)))
+            self._models.move_to_end(name)      # LRU touch
+            entry.requests += 1
+            self.stats_counts["requests"] += 1
+        telemetry.counter_add("serving/registry_requests", 1,
+                              labels={"model": name})
+        return entry
+
+    # ------------------------------------------------------------------
+    # request front end: thin name-routed wrappers over the entry's
+    # Predictor. A request racing a hot swap may catch the outgoing
+    # predictor mid-close; it retries against the current entry instead
+    # of surfacing the internal state ("zero dropped or misrouted").
+    _SWAP_RETRIES = 3
+
+    def _with_predictor(self, name, fn):
+        last = None
+        for _ in range(self._SWAP_RETRIES):
+            entry = self._entry(name)
+            try:
+                result = fn(entry.predictor)
+                self._enforce_budget(exclude=name)
+                return result
+            except log.LightGBMError as exc:
+                if "closed" not in str(exc):
+                    raise
+                last = exc
+        raise last
+
+    def predict(self, name: str, data, **overrides):
+        return self._with_predictor(
+            name, lambda p: p.predict(data, **overrides))
+
+    def predict_one(self, name: str, row, **overrides):
+        return self._with_predictor(
+            name, lambda p: p.predict_one(row, **overrides))
+
+    def submit(self, name: str, row):
+        return self._with_predictor(name, lambda p: p.submit(row))
+
+    def predictor(self, name: str) -> Predictor:
+        """The current Predictor for `name` (hot swaps rebind the name;
+        holders of the old object keep a drained-but-valid predictor)."""
+        return self._entry(name).predictor
+
+    # ------------------------------------------------------------------
+    def _stack_bytes(self) -> Dict[str, int]:
+        with self._lock:
+            entries = list(self._models.values())
+        return {e.name: e.gbdt.compiled_stack_bytes() for e in entries}
+
+    def _enforce_budget(self, exclude: Optional[str] = None) -> int:
+        """LRU-evict resident models' compiled stacks until the total
+        fits the budget. The most-recently-used model (and `exclude`)
+        are never evicted — evicting the model being served would
+        restack it on the very next request. Returns evictions made.
+
+        Called per request because stack bytes GROW during requests
+        (a restack on a previously evicted or invalidated model); with
+        no budget configured (the default) this is one cached-int read,
+        and with one it is a small per-model byte sweep — the
+        documented cost of enforcement."""
+        budget = self._budget_bytes()
+        if budget <= 0:
+            return 0
+        per_model = self._stack_bytes()
+        total = sum(per_model.values())
+        if total <= budget:
+            return 0
+        evicted = 0
+        with self._lock:
+            names = list(self._models)          # LRU -> MRU
+        for name in names[:-1] if len(names) > 1 else []:
+            if total <= budget:
+                break
+            if name == exclude:
+                continue
+            with self._lock:
+                entry = self._models.get(name)
+            if entry is None:
+                continue
+            freed = entry.gbdt._compiled_forest.evict_entries()
+            if freed <= 0:
+                continue
+            total -= freed
+            evicted += 1
+            self.stats_counts["evictions"] += 1
+            telemetry.counter_add("serving/registry_evictions", 1,
+                                  labels={"model": name})
+            log.debug("Registry evicted %s stacks (%d bytes; total %d > "
+                      "budget %d)", name, freed, total + freed, budget)
+        self._mirror_gauges()
+        return evicted
+
+    # ------------------------------------------------------------------
+    def _mirror_gauges(self) -> None:
+        per_model = self._stack_bytes()
+        telemetry.gauge_set("serving/registry_models", len(per_model))
+        telemetry.gauge_set("serving/registry_stack_bytes",
+                            sum(per_model.values()))
+        telemetry.gauge_set("serving/registry_budget_bytes",
+                            self._budget_bytes())
+        telemetry.gauge_set("serving/registry_evictions_total",
+                            self.stats_counts["evictions"])
+        with self._lock:
+            entries = list(self._models.values())
+        for e in entries:
+            telemetry.gauge_set("serving/registry_model_requests",
+                                e.requests, labels={"model": e.name})
+            telemetry.gauge_set("serving/registry_model_version",
+                                e.publish_version,
+                                labels={"model": e.name})
+
+    def stats(self) -> Dict[str, Any]:
+        """Registry-level counters + per-model snapshots (each model's
+        Predictor.stats() under "models"). Mirrored into
+        serving/registry_* gauges, which the hot paths also keep fresh
+        between stats() calls."""
+        per_model = self._stack_bytes()
+        with self._lock:
+            entries = list(self._models.values())
+            counts = dict(self.stats_counts)
+        out: Dict[str, Any] = dict(counts)
+        out["resident_models"] = len(entries)
+        out["stack_bytes"] = sum(per_model.values())
+        out["budget_bytes"] = self._budget_bytes()
+        out["models"] = {}
+        for e in entries:
+            ps = e.predictor.stats()
+            ps["publish_version"] = e.publish_version
+            ps["registry_requests"] = e.requests
+            ps["stack_bytes"] = per_model.get(e.name, 0)
+            out["models"][e.name] = ps
+        self._mirror_gauges()
+        return out
+
+    def close(self) -> None:
+        """Drain and drop every resident model."""
+        with self._lock:
+            self._closed = True
+            entries = list(self._models.values())
+            self._models.clear()
+        for e in entries:
+            if e.listener is not None:
+                e.gbdt.remove_version_listener(e.listener)
+            e.predictor.close()

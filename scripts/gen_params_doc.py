@@ -122,6 +122,28 @@ DESCRIPTIONS = {
     "tpu_predict_pipeline": "double-buffered predict chunk loop: "
                             "dispatch chunk k+1 before fetching chunk "
                             "k so transfer and compute overlap",
+    "tpu_predict_quantize": "quantized serving forest layout: none = "
+                            "bit-exact f32 stacks; f16 = f16 leaf "
+                            "values + bf16 path/category tables "
+                            "(decisions stay bit-exact); int8 = "
+                            "additionally codes split thresholds "
+                            "fixed-point against the per-feature bin "
+                            "bounds (8-bit code space) with a single "
+                            "default-precision selection einsum. "
+                            "Value prediction only; pred_leaf and "
+                            "prediction early stop stay exact f32",
+    "tpu_predict_quantize_tol": "accuracy gate for quantized layouts: "
+                                "max |raw-score delta| vs the f32 "
+                                "stack on a calibration batch, "
+                                "relative to the batch's score scale; "
+                                "a lossier layout is refused with an "
+                                "error instead of served",
+    "tpu_serving_budget_mb": "serving.ModelRegistry device-memory "
+                             "budget for compiled stacks across all "
+                             "resident models, in MiB (0 = unlimited); "
+                             "least-recently-used models' stacks are "
+                             "evicted past it (host trees stay, the "
+                             "next request restacks)",
     "tpu_predict_warmup_rows": "Predictor.warmup() compiles bucket "
                                "programs up to this many rows",
     "tpu_predict_micro_batch": "max concurrent single-row requests "

@@ -1,0 +1,314 @@
+"""Quantized serving layouts (tpu_predict_quantize = f16 / int8).
+
+The contract under test (ISSUE 10): quantized predictions stay within
+the accuracy-delta gate's tolerance of the f32 stack across the model
+matrix (binary / multiclass / regression / lambdarank / categorical /
+missing-typed), `tpu_predict_quantize=none` remains BIT-IDENTICAL to
+the PR-5 behavior, pred_leaf stays exact under any quantize mode, the
+gate refuses a layout whose measured delta exceeds the tolerance, and
+the fixed-point builder refuses forests that exceed the 8-bit code
+space.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import log
+
+TOL = 0.01  # the default tpu_predict_quantize_tol (relative)
+
+
+def _make(n=300, f=6, seed=0, classes=2):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f).astype(np.float32)
+    if classes == 2:
+        y = (X[:, 0] + X[:, 1] * X[:, 2] > 0).astype(np.float32)
+    else:
+        y = (np.argmax(X[:, :classes], axis=1)).astype(np.float32)
+    return X, y
+
+
+def _train(X, y, iters=12, **params):
+    p = {"objective": "binary", "verbose": -1, "num_leaves": 7,
+         "min_data_in_leaf": 5}
+    p.update(params)
+    ds = lgb.Dataset(X, y, params=dict(p))
+    return lgb.train(dict(p), ds, num_boost_round=iters, verbose_eval=False)
+
+
+def _quantized_clone(booster, mode, **extra):
+    params = {"tpu_predict_quantize": mode}
+    params.update(extra)
+    return lgb.Booster(model_str=booster.model_to_string(), params=params)
+
+
+def _scale(raw):
+    return max(1.0, float(np.max(np.abs(raw))))
+
+
+def _assert_within_gate(booster, X, mode, **predict_kw):
+    """Quantized raw scores within the default tolerance of f32 (the
+    same relative metric the gate enforces), and the gate itself passed
+    (no exception)."""
+    ref = booster.predict(X, raw_score=True, **predict_kw)
+    q = _quantized_clone(booster, mode).predict(X, raw_score=True,
+                                                **predict_kw)
+    delta = np.max(np.abs(np.asarray(q) - np.asarray(ref))) / _scale(ref)
+    assert delta <= TOL, (mode, delta)
+    return delta
+
+
+# ---------------------------------------------------------------------------
+# accuracy-delta matrix
+@pytest.mark.parametrize("mode", ["f16", "int8"])
+def test_binary_within_tolerance(mode):
+    X, y = _make()
+    b = _train(X, y)
+    _assert_within_gate(b, X, mode)
+    # transformed outputs ride the same stacks
+    q = _quantized_clone(b, mode)
+    assert np.max(np.abs(q.predict(X) - b.predict(X))) <= TOL
+
+
+@pytest.mark.parametrize("mode", ["f16", "int8"])
+def test_multiclass_within_tolerance(mode):
+    X, y = _make(classes=3)
+    b = _train(X, y, objective="multiclass", num_class=3)
+    _assert_within_gate(b, X, mode)
+
+
+@pytest.mark.parametrize("mode", ["f16", "int8"])
+def test_regression_within_tolerance(mode):
+    rng = np.random.RandomState(1)
+    X = rng.randn(300, 6).astype(np.float32)
+    # large-magnitude targets: the gate tolerance is RELATIVE to the
+    # raw-score scale, so big leaf values must still pass
+    y = (X[:, 0] * 50 + X[:, 1] * X[:, 2] * 20 + 100).astype(np.float32)
+    b = _train(X, y, objective="regression")
+    _assert_within_gate(b, X, mode)
+
+
+@pytest.mark.parametrize("mode", ["f16", "int8"])
+def test_lambdarank_within_tolerance(mode):
+    rng = np.random.RandomState(2)
+    n = 240
+    X = rng.randn(n, 6).astype(np.float32)
+    y = rng.randint(0, 4, size=n).astype(np.float32)
+    p = {"objective": "lambdarank", "verbose": -1, "num_leaves": 7,
+         "min_data_in_leaf": 5}
+    ds = lgb.Dataset(X, y, params=dict(p))
+    ds.set_group([40] * (n // 40))
+    b = lgb.train(dict(p), ds, num_boost_round=10, verbose_eval=False)
+    _assert_within_gate(b, X, mode)
+
+
+@pytest.mark.parametrize("mode", ["f16", "int8"])
+def test_categorical_within_tolerance(mode):
+    rng = np.random.RandomState(3)
+    n = 300
+    cat = rng.randint(0, 12, size=n).astype(np.float32)
+    Xn = rng.randn(n, 4).astype(np.float32)
+    X = np.column_stack([cat, Xn])
+    y = ((cat % 3 == 0) ^ (Xn[:, 0] > 0)).astype(np.float32)
+    b = _train(X, y, categorical_feature=[0], min_data_in_leaf=2)
+    _assert_within_gate(b, X, mode)
+
+
+@pytest.mark.parametrize("mode", ["f16", "int8"])
+def test_missing_typed_splits_within_tolerance(mode):
+    """NaN-bearing training data produces MissingType::NaN splits; the
+    quantized decision (missing-code sentinel / NaN-mask einsum) must
+    reproduce the default directions on NaN serving rows."""
+    rng = np.random.RandomState(4)
+    n = 400
+    X = rng.randn(n, 5).astype(np.float32)
+    X[rng.rand(n, 5) < 0.2] = np.nan
+    y = (np.nan_to_num(X[:, 0]) + np.nan_to_num(X[:, 1]) > 0) \
+        .astype(np.float32)
+    b = _train(X, y, min_data_in_leaf=2)
+    _assert_within_gate(b, X, mode)
+    # decisions are bit-exact: quantized probabilities round-trip the
+    # same leaves, so the delta is pure f16 leaf rounding even on NaNs
+    nan_row = np.full((3, 5), np.nan, np.float32)
+    ref = b.predict(nan_row, raw_score=True)
+    q = _quantized_clone(b, mode).predict(nan_row, raw_score=True)
+    assert np.max(np.abs(q - ref)) / _scale(ref) <= TOL
+
+
+@pytest.mark.parametrize("mode", ["f16", "int8"])
+def test_zero_as_missing_within_tolerance(mode):
+    rng = np.random.RandomState(5)
+    n = 400
+    X = rng.randn(n, 5).astype(np.float32)
+    X[rng.rand(n, 5) < 0.3] = 0.0
+    y = (X[:, 0] + X[:, 1] > 0).astype(np.float32)
+    b = _train(X, y, zero_as_missing=True, min_data_in_leaf=2)
+    _assert_within_gate(b, X, mode)
+
+
+# ---------------------------------------------------------------------------
+# exactness contracts
+def test_none_is_bit_identical_to_uncached_seed():
+    """tpu_predict_quantize=none must keep the PR-5 contract: outputs
+    bit-identical to the per-call-restack seed behavior."""
+    X, y = _make()
+    b = _train(X, y)
+    seed = lgb.Booster(model_str=b.model_to_string(), params={
+        "tpu_predict_cache": "false", "tpu_predict_bucket_min": 0,
+        "tpu_predict_pipeline": "false"})
+    explicit_none = _quantized_clone(b, "none")
+    for n in (1, 17, 300):
+        assert np.array_equal(b.predict(X[:n]), seed.predict(X[:n]))
+        assert np.array_equal(explicit_none.predict(X[:n]),
+                              seed.predict(X[:n]))
+
+
+@pytest.mark.parametrize("mode", ["f16", "int8"])
+def test_pred_leaf_stays_exact(mode):
+    """pred_leaf routes through the exact f32 leaf stacks regardless of
+    quantize mode — leaf indices are an exact contract."""
+    X, y = _make()
+    b = _train(X, y)
+    q = _quantized_clone(b, mode)
+    assert np.array_equal(q.predict(X, pred_leaf=True),
+                          b.predict(X, pred_leaf=True))
+
+
+@pytest.mark.parametrize("mode", ["f16", "int8"])
+def test_split_decisions_bit_exact(mode):
+    """The quantized layouts only round LEAF VALUES: every row must
+    land in the same leaf as f32, so the quantized raw score equals the
+    f16-rounded leaf values summed in f32 — reconstructable exactly
+    from pred_leaf."""
+    X, y = _make(n=240)
+    b = _train(X, y, iters=8)
+    leaves = b.predict(X, pred_leaf=True)
+    models = b._inner.models
+    expected = np.zeros(X.shape[0], np.float32)
+    for ti, t in enumerate(models):
+        lv16 = t.leaf_value.astype(np.float16).astype(np.float32)
+        expected = expected + lv16[leaves[:, ti]]
+    q = _quantized_clone(b, mode).predict(X, raw_score=True)
+    assert np.array_equal(np.asarray(q, np.float32),
+                          expected.astype(np.float32)), mode
+
+
+def test_pred_early_stop_ignores_quantize():
+    X, y = _make()
+    b = _train(X, y)
+    kw = {"pred_early_stop": True, "pred_early_stop_freq": 2,
+          "pred_early_stop_margin": 0.0, "raw_score": True}
+    ref = b.predict(X[:40], **kw)
+    for mode in ("f16", "int8"):
+        assert np.array_equal(_quantized_clone(b, mode).predict(X[:40], **kw),
+                              ref)
+
+
+# ---------------------------------------------------------------------------
+# the gate + layout coexistence + refusals
+def test_gate_refuses_below_measured_delta():
+    X, y = _make()
+    b = _train(X, y)
+    for mode in ("f16", "int8"):
+        q = _quantized_clone(b, mode, tpu_predict_quantize_tol=1e-12)
+        with pytest.raises(log.LightGBMError, match="refused"):
+            q.predict(X[:50])
+
+
+def test_gate_delta_cached_and_rejudged_per_tolerance():
+    """The calibration comparison runs once per (layout, version); a
+    tightened tolerance re-judges the cached measurement."""
+    X, y = _make()
+    b = _train(X, y)
+    q = _quantized_clone(b, "f16")
+    q.predict(X[:50])
+    cache = q._inner._compiled_forest
+    total = q._inner.num_trees()
+    delta = cache.gate_delta(("value", total, 1, "f16"))
+    assert delta is not None and 0 <= delta <= TOL
+    # tighten below the measured delta: same cached measurement, now
+    # refused without a recompare
+    q._inner.config.io.tpu_predict_quantize_tol = min(delta / 2, 1e-12)
+    with pytest.raises(log.LightGBMError, match="refused"):
+        q.predict(X[:50])
+
+
+def test_f32_and_quantized_stacks_coexist():
+    """Switching modes on one booster restacks once per layout, then
+    every mode hits its own cached entry."""
+    X, y = _make()
+    b = _train(X, y)
+    inner = b._inner
+    stats = inner._compiled_forest.stats
+    b.predict(X[:20])                        # f32 stack
+    r0 = stats["restacks"]
+    inner.config.io.tpu_predict_quantize = "f16"
+    b.predict(X[:20])                        # + f16 stack (gate reuses f32)
+    assert stats["restacks"] == r0 + 1
+    inner.config.io.tpu_predict_quantize = "int8"
+    b.predict(X[:20])                        # + int8 stack
+    assert stats["restacks"] == r0 + 2
+    inner.config.io.tpu_predict_quantize = "none"
+    b.predict(X[:20])                        # f32 entry still cached
+    inner.config.io.tpu_predict_quantize = "f16"
+    b.predict(X[:20])                        # f16 entry still cached
+    assert stats["restacks"] == r0 + 2
+    assert stats["bytes"] > 0
+
+
+def test_int8_refuses_overflowing_code_space():
+    """More distinct thresholds per feature than the 8-bit code space
+    -> QuantRefused at build, surfaced as a clear LightGBMError."""
+    from lightgbm_tpu.ops.predict import QuantRefused, stack_trees_quant
+    from lightgbm_tpu.tree import Tree
+
+    trees = []
+    for i in range(260):
+        t = Tree(2)
+        t.split_feature = np.asarray([0], np.int32)
+        t.split_feature_inner = np.asarray([0], np.int32)
+        t.threshold = np.asarray([i * 0.5], np.float64)
+        t.left_child = np.asarray([-1], np.int32)
+        t.right_child = np.asarray([-2], np.int32)
+        t.leaf_value = np.asarray([0.1, -0.1], np.float64)
+        trees.append(t)
+    with pytest.raises(QuantRefused, match="distinct"):
+        stack_trees_quant(trees)
+
+
+def test_invalid_quantize_param_is_fatal():
+    X, y = _make(n=80)
+    with pytest.raises(Exception):
+        _train(X, y, iters=1, tpu_predict_quantize="int4")
+
+
+def test_gate_defers_past_warmup_synthetic_rows():
+    """Predictor.warmup()'s all-zeros rows must not become the cached
+    calibration measurement (16 identical rows traverse one leaf per
+    tree — a near-zero delta would void the gate for the whole model
+    version). The first REAL batch still runs — and can refuse."""
+    X, y = _make()
+    b = _train(X, y)
+    q = _quantized_clone(b, "f16", tpu_predict_quantize_tol=1e-12)
+    pred = q.serving_predictor(raw_score=True)
+    pred.warmup(max_rows=32)           # must NOT raise or record a delta
+    cache = q._inner._compiled_forest
+    assert cache.gate_delta(("value", q._inner.num_trees(), 1, "f16")) \
+        is None
+    with pytest.raises(log.LightGBMError, match="refused"):
+        pred.predict(X[:50])
+
+
+@pytest.mark.parametrize("mode", ["f16", "int8"])
+def test_serving_predictor_reports_quantize(mode):
+    X, y = _make()
+    b = _train(X, y)
+    q = _quantized_clone(b, mode)
+    pred = q.serving_predictor(raw_score=True)
+    pred.warmup(max_rows=32)
+    pred.predict(X[:8])
+    stats = pred.stats()
+    assert stats["quantize"] == mode
+    assert stats["stack_bytes"] > 0

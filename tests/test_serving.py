@@ -357,6 +357,176 @@ def test_tracing_counters_surfaced():
 
 
 # ---------------------------------------------------------------------------
+# multi-model registry (serving/registry.py)
+def test_registry_publish_predict_and_stats(base):
+    from lightgbm_tpu.serving import ModelRegistry
+    X, b, _ = base
+    reg = ModelRegistry(warmup_rows=32)
+    try:
+        rec = reg.publish("main", b)
+        assert rec["publish_version"] == 1
+        assert rec["warmed_buckets"] == [16, 32]
+        assert np.array_equal(reg.predict("main", X[:7]), b.predict(X[:7]))
+        assert np.allclose(reg.predict_one("main", X[0]),
+                           b.predict(X[:1])[0])
+        fut = reg.submit("main", X[1])
+        assert np.allclose(fut.result(timeout=30), b.predict(X[1:2])[0])
+        stats = reg.stats()
+        assert stats["resident_models"] == 1
+        assert stats["stack_bytes"] > 0
+        assert stats["models"]["main"]["registry_requests"] == 3
+        assert stats["models"]["main"]["publish_version"] == 1
+    finally:
+        reg.close()
+
+
+def test_registry_hot_swap_serves_new_model_immediately():
+    from lightgbm_tpu.serving import ModelRegistry
+    X, y = _make()
+    b1 = _train(X, y, iters=4)
+    b2 = _train(X, y, iters=12)
+    assert not np.array_equal(b1.predict(X[:5]), b2.predict(X[:5]))
+    reg = ModelRegistry(warmup_rows=16)
+    try:
+        reg.publish("m", b1)
+        assert np.array_equal(reg.predict("m", X[:5]), b1.predict(X[:5]))
+        rec = reg.publish("m", b2)
+        assert rec["publish_version"] == 2
+        # the swap point: every request AFTER publish() returns must
+        # serve the new model
+        assert np.array_equal(reg.predict("m", X[:5]), b2.predict(X[:5]))
+        assert reg.models() == ["m"]
+        assert reg.stats()["swaps"] == 1
+    finally:
+        reg.close()
+
+
+def test_registry_swap_in_flight_submits_complete():
+    """Futures accepted before a hot swap resolve (on the model that
+    accepted them); submits racing the swap retry onto the new entry —
+    zero dropped either way."""
+    from lightgbm_tpu.serving import ModelRegistry
+    X, y = _make()
+    b1 = _train(X, y, iters=4)
+    b2 = _train(X, y, iters=12)
+    p1 = b1.predict(X)
+    p2 = b2.predict(X)
+    reg = ModelRegistry(warmup_rows=16)
+    try:
+        reg.publish("m", b1)
+        futs = []
+        stop = threading.Event()
+
+        def fire():
+            i = 0
+            while not stop.is_set() and i < 400:
+                futs.append((i % 50, reg.submit("m", X[i % 50])))
+                i += 1
+
+        th = threading.Thread(target=fire)
+        th.start()
+        reg.publish("m", b2)
+        stop.set()
+        th.join()
+        assert len(futs) > 0
+        for i, fut in futs:
+            val = fut.result(timeout=30)    # no dropped/failed futures
+            ok = np.allclose(val, p1[i]) or np.allclose(val, p2[i])
+            assert ok, (i, val, p1[i], p2[i])
+        # post-swap requests serve b2 only
+        assert np.allclose(reg.submit("m", X[3]).result(timeout=30), p2[3])
+    finally:
+        reg.close()
+
+
+def test_registry_budget_evicts_lru_stacks():
+    from lightgbm_tpu.serving import ModelRegistry
+    X, y = _make()
+    b1 = _train(X, y, iters=4)
+    b2 = _train(X, y, iters=4, seed=7)
+    reg = ModelRegistry(budget_mb=1e-3, warmup_rows=0)  # ~1 KiB: too small
+    try:
+        reg.publish("a", b1)
+        reg.publish("b", b2)
+        reg.predict("a", X[:4])
+        reg.predict("b", X[:4])
+        stats = reg.stats()
+        assert stats["evictions"] >= 1
+        assert b1._inner._compiled_forest.stats["evictions"] >= 1
+        # eviction drops stacks, not models: both still serve correctly
+        assert np.array_equal(reg.predict("a", X[:4]), b1.predict(X[:4]))
+        assert np.array_equal(reg.predict("b", X[:4]), b2.predict(X[:4]))
+        # eviction never bumps the model version (stale-stack safety is
+        # version-keyed, eviction is memory-only)
+        assert stats["models"]["a"]["model_version"] \
+            == b1._inner.model_version()
+    finally:
+        reg.close()
+
+
+def test_registry_unknown_model_and_close():
+    from lightgbm_tpu import log
+    from lightgbm_tpu.serving import ModelRegistry
+    X, y = _make()
+    b = _train(X, y, iters=3)
+    reg = ModelRegistry(warmup_rows=0)
+    reg.publish("only", b)
+    try:
+        reg.predict("nope", X[:2])
+        assert False, "unknown model must raise"
+    except log.LightGBMError as exc:
+        assert "not published" in str(exc)
+    assert reg.unpublish("only")
+    assert not reg.unpublish("only")
+    reg.close()
+    try:
+        reg.publish("late", b)
+        assert False, "closed registry must refuse publish"
+    except log.LightGBMError:
+        pass
+
+
+def test_predictor_rejects_wrong_width_rows(base):
+    from lightgbm_tpu import log
+    X, b, _ = base
+    pred = b.serving_predictor()
+    with pytest.raises(log.LightGBMError, match="expects"):
+        pred.predict(X[:3, :4])
+    with pytest.raises(log.LightGBMError, match="expects"):
+        pred.predict_one(X[0][:3])
+    with pytest.raises(log.LightGBMError, match="expects"):
+        pred.submit(np.zeros(2, np.float32))
+    # a wrong-width row must not have burned a retrace or poisoned the
+    # predictor: correct requests still serve
+    assert np.array_equal(pred.predict(X[:3]), b.predict(X[:3]))
+
+
+def test_registry_telemetry_gauges_without_stats_caller():
+    """The hot paths themselves keep the serving/registry_* gauges
+    fresh — no stats() call in this test before the assertion."""
+    from lightgbm_tpu import telemetry
+    from lightgbm_tpu.serving import ModelRegistry
+    X, y = _make()
+    b = _train(X, y, iters=3)
+    telemetry.enable(True)
+    telemetry.reset()
+    reg = ModelRegistry(warmup_rows=0)
+    try:
+        reg.publish("g", b)
+        reg.predict("g", X[:4])
+        snap = telemetry.registry().snapshot()
+        gauges = {g["name"] for g in snap["gauges"]}
+        counters = {c["name"] for c in snap["counters"]}
+        assert "serving/registry_models" in gauges
+        assert "serving/registry_stack_bytes" in gauges
+        assert "serving/registry_requests" in counters
+    finally:
+        reg.close()
+        telemetry.enable(False)
+        telemetry.reset()
+
+
+# ---------------------------------------------------------------------------
 @pytest.mark.slow
 def test_small_batch_speedup_vs_percall_restack_500_trees():
     """Acceptance: repeated small-batch predict on a >=500-tree model is
