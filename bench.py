@@ -9,7 +9,12 @@ forced-host-device data-parallel scaling curve (Mrow-iters/s + per-pass
 comm elements per device count — the MULTICHIP_*.json trajectory);
 BENCH_SHAPE=serve runs the serving-tier suite (quantized f32/f16/int8
 bulk throughput + open-loop sustained load with a mid-run hot swap +
-eviction probe, written to BENCH_SERVE_r07.json) (docs/GPU-Performance.md:74-116: Epsilon
+eviction probe, written to BENCH_SERVE_r07.json);
+BENCH_SHAPE=elastic runs the kill->shrink->resume supervisor cycle
+(scripts/elastic_smoke.py: rank killed at W=4, wedged collective
+detected by the watchdog, elastic resume at W'=2 then W'=1,
+byte-identity vs the uninterrupted serial run — written to
+ELASTIC_r01.json) (docs/GPU-Performance.md:74-116: Epsilon
 400k x 2000 dense-wide, Bosch 1M x 968 sparse, Expo 11M x 700
 categorical; row counts here are scaled to CI-time runs and the metric is
 million row-iterations/sec, which is ~size-invariant).
@@ -883,6 +888,47 @@ def run_multichip() -> list:
     return out
 
 
+def run_elastic() -> dict:
+    """Elasticity gate (BENCH_SHAPE=elastic): run the supervisor's
+    kill -> detect -> shrink -> resume cycle headlessly and commit the
+    machine-readable artifact (ELASTIC_r01.json: ranks killed,
+    detection latency, resume outcome, byte-identity verdict). The
+    parent never touches a backend — every world size runs in its own
+    child (the multichip-gate discipline)."""
+    import subprocess
+    import sys
+
+    out_path = os.environ.get(
+        "BENCH_ELASTIC_OUT",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "ELASTIC_r01.json"))
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "scripts", "elastic_smoke.py")
+    # a stale committed artifact must not masquerade as this run's
+    # result when the smoke dies before writing — remove it up front
+    try:
+        os.unlink(out_path)
+    except OSError:
+        pass
+    cmd = [sys.executable, script, "--out", out_path,
+           "--mode", os.environ.get("BENCH_ELASTIC_MODE", "devices")]
+    try:
+        res = subprocess.run(
+            cmd, capture_output=True, text=True,
+            timeout=float(os.environ.get("BENCH_ELASTIC_TIMEOUT", 900)))
+        rc, tail = res.returncode, (res.stdout + res.stderr)[-800:]
+    except subprocess.TimeoutExpired as exc:
+        rc, tail = 124, "timeout: " + str(exc)
+    try:
+        with open(out_path) as fh:
+            detail = json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        detail = {"error": tail}
+    return {"metric": "elastic_kill_shrink_resume",
+            "value": 1.0 if rc == 0 else 0.0, "unit": "ok", "rc": rc,
+            "detail": detail}
+
+
 def main():
     if os.environ.get("BENCH_MULTICHIP_CHILD"):
         _multichip_child(int(os.environ["BENCH_MULTICHIP_CHILD"]))
@@ -899,6 +945,9 @@ def main():
         # dryrun gate — a dead TPU relay must not hang the harness)
         for entry in run_multichip():
             print(json.dumps(entry), flush=True)
+        return
+    if which == "elastic":
+        print(json.dumps(run_elastic()), flush=True)
         return
     _init_backend_with_retry()
     if which == "amortized":

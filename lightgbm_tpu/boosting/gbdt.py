@@ -211,9 +211,16 @@ def _bagging_mask_impl(ridx, *, seed, n, n_pad, fraction):
     import jax
     import jax.numpy as jnp
     key = jax.random.fold_in(jax.random.PRNGKey(seed), ridx)
-    u = jax.random.uniform(key, (n_pad,))
-    real = jnp.arange(n_pad, dtype=jnp.int32) < n
-    return jnp.where(real & (u < fraction), 1.0, 0.0).astype(jnp.float32)
+    # draw over the REAL rows only, then pad: threefry is not
+    # prefix-stable across output shapes, so a (n_pad,) draw would make
+    # the in-bag mask a function of the padded row count — which varies
+    # with the device count, breaking the bit-identity of training
+    # across world sizes that elastic resume relies on
+    # (scripts/elastic_smoke.py). Over (n,) the mask is a pure function
+    # of (seed, iteration, n) at ANY world size.
+    u = jax.random.uniform(key, (n,))
+    mask = (u < fraction).astype(jnp.float32)
+    return jnp.pad(mask, (0, n_pad - n))
 
 
 _bagging_mask_jit = None
@@ -327,6 +334,28 @@ class GBDT:
             log.fatal("Multi-host training requires tree_learner=data or "
                       "voting (got %s)" % self._tree_learner_kind)
         local_dev = max(1, ndev // nproc)
+        # arm the collective watchdog + heartbeat lease for this run
+        # (parallel/watchdog.py): every host-level collective from here
+        # on — including this init's own allgathers below — runs under
+        # the deadline guard when tpu_collective_timeout_s is set
+        import os as _os
+
+        from .. import telemetry
+        from ..parallel import watchdog
+        net = self.config.network
+        rank = jax.process_index()
+        self._process_rank = rank
+        hb_dir = net.tpu_heartbeat_dir
+        watchdog.configure(
+            timeout_s=net.tpu_collective_timeout_s,
+            failure_dir=hb_dir or None,
+            lease_s=net.tpu_heartbeat_lease_s if hb_dir else None,
+            rank=rank)
+        if hb_dir:
+            _os.makedirs(hb_dir, exist_ok=True)
+            telemetry.set_heartbeat_file(
+                _os.path.join(hb_dir, f"heartbeat_r{rank}.json"))
+            telemetry.heartbeat(0, phase="init", rank=rank)
 
         # row-padding plan: chunk capped by the group-block budget, rows
         # padded to a chunk (x shard) multiple, padded size bucketed into
@@ -1684,7 +1713,32 @@ class GBDT:
             "best_score": {k: dict(v) for k, v in self.best_score.items()},
             "eval_history": list(self._eval_history),
             "extra": self._checkpoint_extra(),
+            # world-size metadata (elastic resume, checkpoint.py): how
+            # many real rows the score block covers, which global rows
+            # they are, and the world this snapshot was taken under —
+            # what a different-sized cohort needs to re-shard it
+            "num_data": int(self._n),
+            "world": {
+                "processes": int(self._num_processes),
+                "rank": int(self._process_rank),
+                "devices": int(self._num_shards),
+                "n_pad": int(self._n_pad),
+            },
         }
+        n_global = getattr(self.train_data, "num_global_rows", None)
+        if n_global:
+            state["num_data_global"] = int(n_global)
+        if self._num_processes > 1:
+            # the partition is identical across a run's snapshots, but
+            # each snapshot must stay SELF-CONTAINED: resume falls back
+            # past corrupt/rotated files to any older snapshot, and a
+            # sidecar partition file would re-introduce a second thing
+            # that can be lost/corrupt independently. The cost is
+            # ~10.7 B64-bytes/row per snapshot, bounded by keep-last-K
+            row_index = getattr(self.train_data, "used_row_indices", None)
+            if row_index is not None and len(row_index) == self._n:
+                state["row_index"] = ckpt.encode_array(
+                    np.asarray(row_index, np.int64))
         return state
 
     def restore_state(self, state: dict, model_str: str) -> None:
@@ -1709,12 +1763,44 @@ class GBDT:
         self._pending_bias = float(state["pending_bias"])
         self._stopped = bool(state["stopped"])
         score = ckpt.decode_array(state["score"])
-        if tuple(score.shape) != tuple(np.asarray(self._score).shape):
-            raise log.LightGBMError(
-                "Checkpoint score shape %s does not match this training "
-                "setup %s — the dataset differs from the checkpointed "
-                "run" % (score.shape, np.asarray(self._score).shape))
-        self._score = jnp.asarray(score)
+        have_shape = tuple(np.asarray(self._score).shape)
+        if tuple(score.shape) == have_shape:
+            self._score = jnp.asarray(score)
+        else:
+            # world-size-elastic resume: a snapshot taken at a different
+            # device/process count pads (or shards) its score block
+            # differently. The REAL rows' exact f32 values carry over
+            # unchanged; the padding region keeps this init's values —
+            # padded rows are weight-0 in every histogram and never read
+            # by eval, so trees stay byte-identical (the same argument
+            # that makes trees bit-identical across device counts,
+            # tests/test_scatter_reduce.py)
+            elastic_ok = bool(getattr(self.config.io, "tpu_elastic_resume",
+                                      True))
+            old_n = state.get("num_data")
+            if (elastic_ok and old_n is not None
+                    and int(old_n) == int(self._n)
+                    and score.shape[0] == have_shape[0]
+                    and score.shape[1] >= int(self._n)):
+                log.info(
+                    "Elastic resume: re-padding checkpoint scores from "
+                    "%s to %s (%d real rows; snapshot world %s, now %d "
+                    "device(s) x %d process(es))",
+                    tuple(score.shape), have_shape, int(self._n),
+                    state.get("world"), self._num_shards,
+                    self._num_processes)
+                fresh = np.asarray(self._score).copy()
+                fresh[:, :int(self._n)] = score[:, :int(self._n)]
+                self._score = jnp.asarray(fresh)
+            else:
+                raise log.LightGBMError(
+                    "Checkpoint score shape %s does not match this "
+                    "training setup %s — the dataset differs from the "
+                    "checkpointed run%s"
+                    % (score.shape, have_shape,
+                       "" if elastic_ok else
+                       " (tpu_elastic_resume=false refuses world-size "
+                       "changes)"))
         valid_encs = state.get("valid_scores", [])
         have = getattr(self, "_valid_score", [])
         if len(valid_encs) != len(have):

@@ -70,7 +70,12 @@ def _goss_impl(g, h, it, *, seed, k, n, n_pad, top_k, other_k):
     thresh = -jnp.sort(-mag)[top_k - 1]
     is_top = mag >= thresh
     key = jax.random.fold_in(jax.random.PRNGKey(seed), it)
-    u = jax.random.uniform(key, (n_pad,))
+    # (n,) then pad, like the bagging mask in gbdt.py: a (n_pad,) draw
+    # would tie the sample to the padded row count (a function of the
+    # device count — threefry is not prefix-stable across shapes) and
+    # break cross-world-size training bit-identity
+    u = jnp.pad(jax.random.uniform(key, (n,)), (0, n_pad - n),
+                constant_values=1.0)
     rest_p = other_k / max(1, n - top_k)
     multiply = (n - top_k) / other_k
     w = jnp.where(is_top, 1.0,

@@ -65,6 +65,16 @@ _FINGERPRINT_EXCLUDE = {
     # allreduce grow bit-identical trees, tests/test_scatter_reduce.py)
     # — a resumed run may switch schedules
     "tpu_hist_reduce",
+    # world-size-elastic resume (ISSUE 11): everything that names or
+    # derives from the world size must stay OUT of the fingerprint —
+    # a snapshot taken at W ranks must be accepted at W' ranks (trees
+    # are bit-identical across device counts; scores re-shard through
+    # restore). The watchdog/heartbeat knobs never change the
+    # trajectory either; a resumed run may re-arm them freely
+    "num_machines", "num_machine", "local_listen_port", "local_port",
+    "time_out", "machine_list_filename",
+    "tpu_collective_timeout_s", "tpu_heartbeat_dir",
+    "tpu_heartbeat_lease_s", "tpu_elastic_resume",
     "output_model", "output_result", "input_model", "convert_model",
     "config_file", "machine_list_file", "snapshot_freq", "verbose",
     "metric_freq", "num_iterations", "num_threads", "task",
@@ -227,6 +237,107 @@ class CheckpointManager:
     def available_iterations(self) -> List[int]:
         return [it for it, _ in self.snapshots()]
 
+    # -- cross-rank discovery (world-size-elastic resume) ---------------
+    def snapshots_all_ranks(self) -> Dict[int, List[Tuple[int, str]]]:
+        """{rank: [(iteration, path), ...]} across EVERY rank series in
+        the directory — the elastic-resume view: a shrunken cohort must
+        read the dead ranks' row shards, a grown cohort's new ranks
+        have no series of their own at all."""
+        out: Dict[int, List[Tuple[int, str]]] = {}
+        for name in os.listdir(self.directory):
+            m = self._NAME_RE.match(name)
+            if m:
+                out.setdefault(int(m.group(2)), []).append(
+                    (int(m.group(1)), os.path.join(self.directory, name)))
+        for files in out.values():
+            files.sort()
+        return out
+
+    def load_latest_any_rank(self) -> Optional[Tuple[Dict[str, Any], str]]:
+        """Newest validating snapshot across ALL rank series (own rank
+        preferred at equal iteration, then the lowest rank) — the
+        starting point when THIS rank has no series (a cohort grown
+        past the original world size)."""
+        candidates: List[Tuple[int, int, str]] = []
+        for rank, files in self.snapshots_all_ranks().items():
+            for iteration, path in files:
+                # own rank sorts first at equal iteration
+                candidates.append(
+                    (iteration, 0 if rank == self.rank else rank + 1, path))
+        for iteration, _, path in sorted(candidates,
+                                         key=lambda t: (-t[0], t[1])):
+            try:
+                return self.load(path), path
+            except (CheckpointError, OSError) as exc:
+                log.warning("Skipping unusable checkpoint %s (%s)",
+                            path, exc)
+        return None
+
+    def load_world_iteration(self, iteration: int,
+                             expected_ranks: Optional[int] = None
+                             ) -> Dict[int, Dict[str, Any]]:
+        """Every rank's VALIDATING payload at `iteration`; corrupt or
+        truncated files are skipped (a rank that died mid-write is the
+        expected producer of those). With `expected_ranks` (the
+        snapshot's recorded world size), an incomplete set raises —
+        reassembling a partial world would silently drop rows — and
+        the error names which files were absent vs unreadable."""
+        out: Dict[int, Dict[str, Any]] = {}
+        bad: Dict[int, str] = {}
+        for rank, files in self.snapshots_all_ranks().items():
+            for it, path in files:
+                if it == int(iteration):
+                    try:
+                        out[rank] = self.load(path)
+                    except (CheckpointError, OSError) as exc:
+                        bad[rank] = str(exc)
+        if expected_ranks is not None:
+            missing = [r for r in range(int(expected_ranks))
+                       if r not in out]
+            if missing:
+                raise CheckpointError(
+                    "Elastic resume needs every original rank's snapshot "
+                    "at iteration %d, but rank file(s) %s are missing "
+                    "from %s%s (the checkpoint directory must be shared "
+                    "storage reachable by the resuming cohort)"
+                    % (int(iteration), missing, self.directory,
+                       "; unreadable: %s" % bad if bad else ""))
+            # drop ranks BEYOND the recorded world: an earlier larger
+            # cohort's leftover files (never rotated once their ranks
+            # died) would otherwise pollute the reassembly with stale
+            # overlapping row ownership
+            out = {r: p for r, p in out.items()
+                   if r < int(expected_ranks)}
+        return out
+
+    def latest_complete_iteration(
+            self, expected_ranks: int, before: Optional[int] = None
+    ) -> Optional[Tuple[int, Dict[int, Dict[str, Any]]]]:
+        """Newest iteration at which EVERY rank 0..expected_ranks-1 has
+        a validating snapshot (optionally capped at `before`, exclusive)
+        — the elastic-resume fallback when a dying rank left the series
+        skewed: rank 0 wrote iteration k but rank 1 only reached k-1,
+        so k-1 is the newest state the whole world can reassemble.
+        Returns (iteration, {rank: payload}) — the validated payloads
+        ride along so callers don't decode every snapshot twice."""
+        by_rank = self.snapshots_all_ranks()
+        ranks = range(int(expected_ranks))
+        if any(r not in by_rank for r in ranks):
+            return None
+        common = set.intersection(
+            *(set(it for it, _ in by_rank[r]) for r in ranks))
+        for it in sorted(common, reverse=True):
+            if before is not None and it >= int(before):
+                continue
+            payloads = {}
+            try:
+                for r in ranks:
+                    payloads[r] = self.load(dict(by_rank[r])[it])
+            except (CheckpointError, OSError):
+                continue
+            return it, payloads
+        return None
+
     # -- write ----------------------------------------------------------
     def save(self, payload: Dict[str, Any], iteration: int) -> str:
         data = json.dumps(payload, sort_keys=True,
@@ -293,3 +404,104 @@ class CheckpointManager:
                             "falling back to the previous snapshot",
                             path, exc)
         return None
+
+
+# ---------------------------------------------------------------------------
+# world-size-elastic reassembly (ISSUE 11)
+# ---------------------------------------------------------------------------
+def payload_world(payload: Dict[str, Any]) -> Dict[str, Any]:
+    """The world-size record a snapshot was taken under. Pre-elastic
+    snapshots carry none — treat them as single-process (their scores
+    cover the whole dataset, which is exactly what processes=1 means)."""
+    return dict(payload.get("state", {}).get("world")
+                or {"processes": 1, "rank": 0})
+
+
+def elastic_local_state(payloads: Dict[int, Dict[str, Any]],
+                        new_row_index: np.ndarray,
+                        base_rank: Optional[int] = None) -> Dict[str, Any]:
+    """Re-shard a W-rank snapshot set onto ONE rank of a W'-rank world.
+
+    Every original rank's state carries its real-row score block plus
+    the global row indices those rows came from (`row_index`, recorded
+    by GBDT.checkpoint_state under multi-process training; implicit
+    arange for processes=1). The blocks concatenate into the exact
+    global [k, n_global] f32 score matrix, from which the new rank's
+    partition (`new_row_index`) is sliced — per-row f32 values move
+    untouched, so the elastically-resumed run stays byte-identical to
+    an uninterrupted one.
+
+    Returns a state dict (the `payload["state"]` shape) for the new
+    rank: the base rank's state with `score`/`num_data`/`row_index`
+    replaced. Host-RNG and callback state are replicated across ranks
+    by construction, so any base rank is equivalent; `base_rank`
+    defaults to the lowest available."""
+    if not payloads:
+        raise CheckpointError("Elastic resume: no snapshot payloads")
+    ranks = sorted(payloads)
+    if base_rank is None or base_rank not in payloads:
+        base_rank = ranks[0]
+    base = payloads[base_rank]
+
+    blocks = []       # (global_indices, [k, n_local] real-row scores)
+    n_global = 0
+    k = None
+    for rank in ranks:
+        state = payloads[rank].get("state", {})
+        if "num_data" not in state:
+            raise CheckpointError(
+                "Elastic resume: rank %d's snapshot predates world-size "
+                "metadata (written by an older build); it can only be "
+                "restored at its original world size" % rank)
+        n_local = int(state["num_data"])
+        score = decode_array(state["score"])
+        if k is None:
+            k = score.shape[0]
+        elif score.shape[0] != k:
+            raise CheckpointError(
+                "Elastic resume: rank %d's score has %d classes, "
+                "expected %d" % (rank, score.shape[0], k))
+        if "row_index" in state:
+            gidx = decode_array(state["row_index"]).astype(np.int64)
+            if gidx.shape[0] != n_local:
+                raise CheckpointError(
+                    "Elastic resume: rank %d records %d row indices for "
+                    "%d rows" % (rank, gidx.shape[0], n_local))
+        elif len(ranks) == 1:
+            gidx = np.arange(n_local, dtype=np.int64)
+        else:
+            raise CheckpointError(
+                "Elastic resume: rank %d's snapshot carries no global "
+                "row indices (pre-partitioned data files record none); "
+                "restore at the original world size instead" % rank)
+        blocks.append((gidx, score[:, :n_local]))
+        n_global = max(n_global, int(gidx.max()) + 1 if n_local else 0)
+
+    global_score = np.zeros((k, n_global), np.float32)
+    covered = np.zeros(n_global, bool)
+    for gidx, score in blocks:
+        if covered[gidx].any():
+            raise CheckpointError(
+                "Elastic resume: overlapping row ownership across rank "
+                "snapshots — the series mixes incompatible runs")
+        global_score[:, gidx] = score
+        covered[gidx] = True
+    if not covered.all():
+        raise CheckpointError(
+            "Elastic resume: rank snapshots cover %d of %d global rows "
+            "— a rank series is missing or stale"
+            % (int(covered.sum()), n_global))
+
+    new_idx = np.asarray(new_row_index, np.int64)
+    if new_idx.size and (new_idx.min() < 0 or new_idx.max() >= n_global):
+        raise CheckpointError(
+            "Elastic resume: the resuming rank's partition indexes row "
+            "%d but the snapshot world only covers %d rows — the "
+            "dataset differs from the checkpointed run"
+            % (int(new_idx.max()), n_global))
+    state = dict(base["state"])
+    state["score"] = encode_array(
+        np.ascontiguousarray(global_score[:, new_idx]))
+    state["num_data"] = int(new_idx.size)
+    state["row_index"] = encode_array(new_idx)
+    return state

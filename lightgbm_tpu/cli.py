@@ -226,6 +226,19 @@ def run_train(params: Dict, cfg: Config) -> None:
         from . import telemetry
         telemetry.enable(True)
         telemetry.install_observer()
+    if cfg.network.tpu_collective_timeout_s > 0 \
+            or cfg.network.tpu_heartbeat_dir:
+        # armed BEFORE the dataset build: distributed bin finding and
+        # the pre-partition sample merge are collectives too — a rank
+        # that dies while its peers are still LOADING must produce the
+        # same clean RC_RANK_FAILURE exit as one that dies mid-training
+        # (GBDT.init re-arms with the rank once the backend is up)
+        from .parallel import watchdog
+        watchdog.configure(
+            timeout_s=cfg.network.tpu_collective_timeout_s,
+            failure_dir=cfg.network.tpu_heartbeat_dir or None,
+            lease_s=cfg.network.tpu_heartbeat_lease_s
+            if cfg.network.tpu_heartbeat_dir else None)
     log.info("Loading train data from %s", cfg.data)
     train_set = _build_dataset(cfg.data, params, cfg)
     valid_sets, valid_names = [], []

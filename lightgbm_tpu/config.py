@@ -143,6 +143,17 @@ class IOConfig:
     tpu_checkpoint_dir: str = ""
     tpu_checkpoint_interval: int = 10
     tpu_checkpoint_keep: int = 3
+    # world-size-elastic resume (lightgbm_tpu/checkpoint.py +
+    # boosting/gbdt.py): accept a snapshot taken at a different world
+    # size (device count and/or process count) — scores are re-sharded
+    # onto the new row layout and the scatter-reduce owned-group tables
+    # rebuild for the new device count. Since trees are bit-identical
+    # across DEVICE counts, a device-count-elastic resume stays
+    # byte-identical to an uninterrupted run; across PROCESS counts the
+    # exact per-row f32 state is restored but bitwise equality is not
+    # guaranteed (cross-process row assembly permutes the f32 summation
+    # order). false restores the strict same-shape-only refusal
+    tpu_elastic_resume: bool = True
     # unified telemetry (lightgbm_tpu/telemetry/): when a directory is
     # set, training opens a structured JSONL run log there (header +
     # one record per iteration + events + summary, appended so a
@@ -326,6 +337,25 @@ class NetworkConfig:
     local_listen_port: int = 12400
     time_out: int = 120
     machine_list_filename: str = ""
+    # collective watchdog (lightgbm_tpu/parallel/watchdog.py): deadline,
+    # in seconds, for every host-level collective dispatch (grower
+    # per-pass dispatch, multihost allgather/agree, telemetry
+    # aggregation). On expiry the rank dumps per-thread stacks + a
+    # structured rank_failure event and exits with rc 113
+    # (watchdog.RC_RANK_FAILURE) instead of hanging on a dead peer.
+    # 0 disables. Must exceed worst-case XLA compile time: the first
+    # dispatch of a new shape compiles under the guard
+    tpu_collective_timeout_s: float = 0.0
+    # per-rank heartbeat/failure evidence directory: each rank writes
+    # heartbeat_r<rank>.json on every grower dispatch and training
+    # iteration, and rank_failure_r<rank>.json on watchdog expiry — the
+    # lease view an external supervisor (scripts/elastic_smoke.py)
+    # reads to tell WHICH rank died and why
+    tpu_heartbeat_dir: str = ""
+    # heartbeat lease duration: a supervisor declares a rank dead when
+    # its heartbeat is older than this (stamped into the heartbeat file
+    # so readers need no config)
+    tpu_heartbeat_lease_s: float = 60.0
 
 
 @dataclass

@@ -96,7 +96,13 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
     # recorder is None when telemetry is off and costs nothing then
     recorder = telemetry_mod.start_run(booster._inner, params)
     recorder_ref["r"] = recorder
+    # out-of-band reporters (the collective watchdog's rank_failure
+    # path) reach the run log through the active-recorder registry
+    telemetry_mod.set_active_recorder(recorder)
     if recorder is not None and start_iter > 0:
+        elastic_info = getattr(booster, "_elastic_resume_info", None)
+        if elastic_info:
+            recorder.event("elastic_resume", **elastic_info)
         recorder.event("resume", iteration=start_iter)
     if recorder is not None:
         # dataset-construction trail: the ingest subsystem's counters
@@ -183,12 +189,19 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
         try:
             booster._inner.finalize_training()
         finally:
-            if recorder is not None:
-                import sys
-                exc = sys.exc_info()[1]
-                recorder.close(
-                    status="finished" if exc is None else
-                    f"error: {type(exc).__name__}")
+            try:
+                if recorder is not None:
+                    import sys
+                    exc = sys.exc_info()[1]
+                    recorder.close(
+                        status="finished" if exc is None else
+                        f"error: {type(exc).__name__}")
+            finally:
+                # cleared AFTER close: the end-of-run aggregate is a
+                # collective that can wedge on a dead peer, and the
+                # watchdog's rank_failure event must still reach the
+                # run log through the active-recorder registry
+                telemetry_mod.set_active_recorder(None)
     return booster
 
 
@@ -225,17 +238,32 @@ def _setup_checkpointing(booster: Booster, callbacks: List,
     cfg = inner.config
     if not cfg.io.tpu_checkpoint_dir:
         return 0
+    # fingerprint on GLOBAL rows: the local shard size is a function of
+    # the world size, and a snapshot taken at W ranks must be accepted
+    # at W' ranks (world-size-elastic resume, ISSUE 11)
+    n_fp = int(getattr(inner.train_data, "num_global_rows", 0)
+               or inner._n)
     fingerprint = checkpoint_mod.config_fingerprint(
-        cfg.raw_params, inner._n, inner.max_feature_idx + 1,
+        cfg.raw_params, n_fp, inner.max_feature_idx + 1,
         cfg.boosting_type)
     manager = checkpoint_mod.CheckpointManager(
         cfg.io.tpu_checkpoint_dir, keep_last=cfg.io.tpu_checkpoint_keep)
     stateful = [cb for cb in callbacks if hasattr(cb, "checkpoint_state")]
+    elastic_ok = bool(cfg.io.tpu_elastic_resume)
 
     start_iter = 0
     found = manager.load_latest()
+    if found is None and elastic_ok:
+        # no series for THIS rank (the cohort grew past the original
+        # world size, or a single process is adopting a multi-rank
+        # directory): start from the newest snapshot any rank wrote
+        found = manager.load_latest_any_rank()
     payload = found[0] if found else None
     candidate = int(payload["iteration"]) if payload else 0
+    # world payloads already decoded on this path (iteration -> {rank:
+    # payload}); the repartition reassembly below reuses them instead
+    # of re-reading + re-checksumming every rank's snapshot
+    world_cache: Dict[int, Dict[int, Any]] = {}
     if inner._num_processes > 1:
         from .parallel.multihost import agree_on_iteration
         target = agree_on_iteration(candidate)
@@ -245,16 +273,33 @@ def _setup_checkpointing(booster: Booster, callbacks: List,
             try:
                 payload = manager.load_iteration(target)
             except (checkpoint_mod.CheckpointError, OSError) as exc:
-                # the ranks' snapshot series drifted further apart than
-                # keep-last-K retains; silently diverging (this rank
-                # fresh, others restored) would be far worse than
-                # stopping, so make the operator decide
-                raise LightGBMError(
-                    "Multi-host resume: the ranks agreed on iteration %d "
-                    "but this rank cannot load it (%s). Clear %s on all "
-                    "hosts to restart from scratch, or restore the "
-                    "missing snapshot files." % (target, exc,
-                                                 manager.directory))
+                # this rank has no snapshot at the agreed iteration —
+                # either the series drifted further apart than
+                # keep-last-K retains, or this rank is NEW (a grown
+                # cohort adopting another rank's series). Elastic
+                # resume can still proceed from any ORIGINAL rank's
+                # payload at that iteration (the repartition path below
+                # reassembles the scores world-wide); without one,
+                # silently diverging (this rank fresh, others restored)
+                # would be far worse than stopping, so make the
+                # operator decide
+                payload = None
+                if elastic_ok:
+                    # corrupt peer files are skipped inside
+                    # load_world_iteration — any readable original
+                    # payload is enough to anchor the reassembly below
+                    at_target = manager.load_world_iteration(target)
+                    if at_target:
+                        world_cache[int(target)] = at_target
+                        payload = at_target.get(manager.rank,
+                                                at_target[min(at_target)])
+                if payload is None:
+                    raise LightGBMError(
+                        "Multi-host resume: the ranks agreed on "
+                        "iteration %d but this rank cannot load it "
+                        "(%s). Clear %s on all hosts to restart from "
+                        "scratch, or restore the missing snapshot "
+                        "files." % (target, exc, manager.directory))
     if payload is not None:
         path = manager.path_for(int(payload["iteration"]))
         if payload.get("fingerprint") != fingerprint:
@@ -265,6 +310,86 @@ def _setup_checkpointing(booster: Booster, callbacks: List,
                 "written). Restore the original configuration or point "
                 "tpu_checkpoint_dir at a fresh directory."
                 % path)
+        # world-size-elastic resume: the snapshot's row partition
+        # differs from this run's (different process count, or this
+        # rank adopting another rank's series) — reassemble the global
+        # score matrix from EVERY original rank's snapshot and slice
+        # this rank's new partition out of it (checkpoint.py)
+        snap_world = checkpoint_mod.payload_world(payload)
+        snap_procs = int(snap_world.get("processes", 1))
+        repartition = (snap_procs != inner._num_processes
+                       or int(snap_world.get("rank", manager.rank))
+                       != manager.rank)
+        if repartition:
+            if not elastic_ok:
+                raise LightGBMError(
+                    "Snapshot %s was taken at world size %d (rank %s) "
+                    "but this run has %d process(es); set "
+                    "tpu_elastic_resume=true to re-shard it or restore "
+                    "the original world size."
+                    % (path, snap_procs, snap_world.get("rank"),
+                       inner._num_processes))
+            it = int(payload["iteration"])
+            try:
+                payloads = world_cache.get(it)
+                if payloads is not None and not any(
+                        r not in payloads for r in range(snap_procs)):
+                    # membership, not count: a stale extra-rank file in
+                    # the cache could mask a MISSING original rank
+                    payloads = {r: p for r, p in payloads.items()
+                                if r < snap_procs}
+                else:
+                    payloads = manager.load_world_iteration(
+                        it, expected_ranks=snap_procs)
+            except checkpoint_mod.CheckpointError as exc:
+                # a dying rank leaves the series SKEWED (rank 0 wrote
+                # iteration k, rank 1 only reached k-1): fall back to
+                # the newest iteration the whole original world can
+                # reassemble instead of refusing the resume outright
+                fallback = manager.latest_complete_iteration(
+                    snap_procs, before=it)
+                if fallback is None:
+                    raise
+                fb_iter, payloads = fallback
+                log.warning(
+                    "Elastic resume: iteration %d is incomplete across "
+                    "the original ranks (%s); falling back to the "
+                    "newest complete iteration %d", it, exc, fb_iter)
+                payload = payloads.get(manager.rank,
+                                       payloads[min(payloads)])
+            # EVERY merged payload must carry this run's fingerprint,
+            # not just the anchor: a stale rank file left over from a
+            # differently-configured run in the same directory would
+            # otherwise blend silently into the reassembled scores —
+            # the exact blend the fingerprint contract exists to refuse
+            stale = {r: p.get("fingerprint")
+                     for r, p in payloads.items()
+                     if p.get("fingerprint") != fingerprint}
+            if stale:
+                raise LightGBMError(
+                    "Refusing elastic resume from iteration %d in %s: "
+                    "rank file(s) %s carry a different config "
+                    "fingerprint (leftovers from another run?). Clear "
+                    "the directory or restore the original "
+                    "configuration."
+                    % (int(payload["iteration"]), manager.directory,
+                       sorted(stale)))
+            row_index = getattr(inner.train_data, "used_row_indices", None)
+            if row_index is None or len(row_index) != inner._n:
+                row_index = np.arange(inner._n, dtype=np.int64)
+            state = checkpoint_mod.elastic_local_state(
+                payloads, row_index, base_rank=manager.rank)
+            payload = dict(payload, state=state)
+            log.info(
+                "Elastic resume: re-sharded a %d-rank snapshot set at "
+                "iteration %d onto rank %d of %d process(es)",
+                snap_procs, int(payload["iteration"]), manager.rank,
+                inner._num_processes)
+            booster._elastic_resume_info = {
+                "from_processes": snap_procs,
+                "to_processes": int(inner._num_processes),
+                "iteration": int(payload["iteration"]),
+            }
         booster.restore_state(payload)
         cb_states = payload.get("callbacks", {})
         for idx, cb in enumerate(stateful):

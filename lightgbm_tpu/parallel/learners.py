@@ -43,6 +43,7 @@ from .. import log
 from .. import telemetry
 from ..learner.grow import GrowerConfig, grow_tree
 from ..testing import faults
+from . import watchdog
 
 
 def shard_map_compat(f, mesh, in_specs, out_specs):
@@ -161,13 +162,30 @@ class DataParallelGrower:
 
     def __call__(self, binned, grad, hess, row_weight, feature_mask,
                  fmeta: Dict, n_valid=None):
-        # injection point: a severed/restarting worker surfaces here as
-        # a failed collective dispatch (testing/faults.py)
-        faults.inject("collective.call")
-        # liveness evidence for watchdogs (scripts/dryrun_multichip.py):
-        # an rc-124 timeout inside a collective leaves the last grower
-        # dispatch this rank reached, not just a dead process
+        # the per-pass dispatch is a host-level collective seam: under
+        # multi-process training the global-row-array assembly below
+        # blocks on every peer, and a dead/wedged rank would park this
+        # one here forever — the deadline guard converts that into a
+        # diagnosable RC_RANK_FAILURE exit (parallel/watchdog.py). Note
+        # the first dispatch of a new shape compiles under the guard,
+        # so tpu_collective_timeout_s must exceed worst-case compile.
         self._calls += 1
+        with watchdog.deadline("collective.dispatch",
+                               iteration=self._calls):
+            return self._dispatch(binned, grad, hess, row_weight,
+                                  feature_mask, fmeta, n_valid)
+
+    def _dispatch(self, binned, grad, hess, row_weight, feature_mask,
+                  fmeta: Dict, n_valid=None):
+        # injection point: a severed/restarting worker surfaces here as
+        # a failed collective dispatch; a WEDGED worker surfaces as an
+        # injected sleep the deadline guard above must catch
+        # (testing/faults.py wedge_collective)
+        faults.inject("collective.call")
+        # liveness evidence for watchdogs (scripts/dryrun_multichip.py,
+        # scripts/elastic_smoke.py): an rc-124 timeout inside a
+        # collective leaves the last grower dispatch this rank reached,
+        # not just a dead process
         telemetry.heartbeat(self._calls, phase="grower_dispatch")
         telemetry.counter_add("parallel/grower_calls", 1)
         owned_feats = None
@@ -272,8 +290,15 @@ class FeatureParallelGrower:
 
     def __call__(self, binned, grad, hess, row_weight, feature_mask, fmeta,
                  n_valid=None):
-        faults.inject("collective.call")
         self._calls = getattr(self, "_calls", 0) + 1
+        with watchdog.deadline("collective.dispatch",
+                               iteration=self._calls):
+            return self._dispatch(binned, grad, hess, row_weight,
+                                  feature_mask, fmeta, n_valid)
+
+    def _dispatch(self, binned, grad, hess, row_weight, feature_mask, fmeta,
+                  n_valid=None):
+        faults.inject("collective.call")
         telemetry.heartbeat(self._calls, phase="grower_dispatch")
         telemetry.counter_add("parallel/grower_calls", 1)
         cfg = self.cfg

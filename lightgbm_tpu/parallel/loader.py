@@ -82,18 +82,26 @@ def load_partition(path: str, rank: int, num_machines: int,
 def jax_process_allgather(payload: str, rank: int, num_machines: int
                           ) -> List[str]:
     """Allgather JSON strings across jax processes (the BinMapper exchange
-    of dataset_loader.cpp:780-817 on the jax distributed runtime)."""
-    import jax.numpy as jnp
-    from jax.experimental import multihost_utils
+    of dataset_loader.cpp:780-817 on the jax distributed runtime).
+    Deadline-guarded (parallel/watchdog.py): a rank that died during
+    loading must produce a clean RC_RANK_FAILURE exit on its peers, not
+    an indefinite block in dataset construction."""
+    from ..testing import faults
+    from .watchdog import deadline
 
-    raw = np.frombuffer(payload.encode("utf-8"), np.uint8)
-    n = np.zeros((), np.int64) + len(raw)
-    lens = multihost_utils.process_allgather(jnp.asarray(n))
-    buf = np.zeros(int(lens.max()), np.uint8)
-    buf[:len(raw)] = raw
-    bufs = multihost_utils.process_allgather(jnp.asarray(buf))
-    return [bytes(np.asarray(bufs[i][:int(lens[i])])).decode("utf-8")
-            for i in range(num_machines)]
+    with deadline("loader.allgather"):
+        faults.inject("loader.allgather")
+        import jax.numpy as jnp
+        from jax.experimental import multihost_utils
+
+        raw = np.frombuffer(payload.encode("utf-8"), np.uint8)
+        n = np.zeros((), np.int64) + len(raw)
+        lens = multihost_utils.process_allgather(jnp.asarray(n))
+        buf = np.zeros(int(lens.max()), np.uint8)
+        buf[:len(raw)] = raw
+        bufs = multihost_utils.process_allgather(jnp.asarray(buf))
+        return [bytes(np.asarray(bufs[i][:int(lens[i])])).decode("utf-8")
+                for i in range(num_machines)]
 
 
 def default_comm(num_machines: int):

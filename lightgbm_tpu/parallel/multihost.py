@@ -129,40 +129,64 @@ def global_row_array(local_np, mesh, axis: str):
     return jax.make_array_from_process_local_data(sharding, local_np)
 
 
-def allgather_bytes(blob: bytes):
+def allgather_bytes(blob: bytes, timeout_s: Optional[float] = None,
+                    site: str = "multihost.allgather_bytes"):
     """Gather one variable-length byte blob from every process, in rank
     order (single-process: the identity). Used by the telemetry export
     to merge per-rank metric snapshots at end of run — lengths are
-    allgathered first, then the payloads ride one padded uint8 array."""
-    import jax
-    if jax.process_count() <= 1:
-        return [bytes(blob)]
-    import jax.numpy as jnp
-    import numpy as np
-    from jax.experimental import multihost_utils
-    lengths = np.asarray(multihost_utils.process_allgather(
-        jnp.asarray(np.int64(len(blob)))))
-    max_len = int(lengths.max())
-    padded = np.zeros(max_len, np.uint8)
-    padded[:len(blob)] = np.frombuffer(blob, np.uint8)
-    gathered = np.asarray(multihost_utils.process_allgather(
-        jnp.asarray(padded)))
-    return [gathered[r, :int(lengths[r])].tobytes()
-            for r in range(gathered.shape[0])]
+    allgathered first, then the payloads ride one padded uint8 array.
+
+    A dead peer would block this FOREVER (the jax runtime has no
+    per-collective timeout) — so the whole exchange runs under the
+    collective watchdog's deadline guard (`tpu_collective_timeout_s`):
+    on expiry this rank dumps per-thread stacks + a `rank_failure`
+    event and exits with watchdog.RC_RANK_FAILURE instead of hanging.
+    `site` labels the failure evidence; callers with a distinct seam
+    (the telemetry aggregation) pass their own so exactly ONE guard is
+    armed and the recorded site is deterministic."""
+    from ..testing import faults
+    from . import watchdog
+    with watchdog.deadline(site, timeout_s=timeout_s):
+        # inside the guard: an injected wedge/fault stands in for the
+        # collective itself blocking or dying (testing/faults.py)
+        faults.inject("multihost.allgather")
+        import jax
+        if jax.process_count() <= 1:
+            return [bytes(blob)]
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.experimental import multihost_utils
+        lengths = np.asarray(multihost_utils.process_allgather(
+            jnp.asarray(np.int64(len(blob)))))
+        max_len = int(lengths.max())
+        padded = np.zeros(max_len, np.uint8)
+        padded[:len(blob)] = np.frombuffer(blob, np.uint8)
+        gathered = np.asarray(multihost_utils.process_allgather(
+            jnp.asarray(padded)))
+        return [gathered[r, :int(lengths[r])].tobytes()
+                for r in range(gathered.shape[0])]
 
 
-def agree_on_iteration(iteration: int) -> int:
+def agree_on_iteration(iteration: int,
+                       timeout_s: Optional[float] = None) -> int:
     """Checkpoint resume under multi-host training: every process holds
     its own row-shard snapshot series, and a preemption can land between
     one rank's write and another's — so the ranks vote and everyone
     restarts from the MINIMUM iteration all of them can restore
-    (0 = some rank has nothing usable, start fresh)."""
-    import jax
-    if jax.process_count() <= 1:
-        return int(iteration)
-    import jax.numpy as jnp
-    import numpy as np
-    from jax.experimental import multihost_utils
-    gathered = multihost_utils.process_allgather(
-        jnp.asarray(np.int64(iteration)))
-    return int(np.asarray(gathered).min())
+    (0 = some rank has nothing usable, start fresh). Deadline-guarded
+    like allgather_bytes: a peer that died before the vote must produce
+    a clean RC_RANK_FAILURE exit, not an indefinite block."""
+    from ..testing import faults
+    from . import watchdog
+    with watchdog.deadline("multihost.agree_on_iteration",
+                           timeout_s=timeout_s):
+        faults.inject("multihost.agree")
+        import jax
+        if jax.process_count() <= 1:
+            return int(iteration)
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.experimental import multihost_utils
+        gathered = multihost_utils.process_allgather(
+            jnp.asarray(np.int64(iteration)))
+        return int(np.asarray(gathered).min())

@@ -1,0 +1,311 @@
+"""Collective watchdogs + rank-failure detection for elastic training.
+
+The reference LightGBM's socket collectives carry their own
+connect/retry/timeout machinery (`src/network/linkers_socket.cpp`
+TimeOut handling); the jax distributed runtime does not — a rank that
+dies or wedges mid-run leaves every peer blocked FOREVER inside the
+next collective (`multihost.allgather_bytes`, `agree_on_iteration`,
+the data-parallel grower's per-pass dispatch). This module converts
+those indefinite hangs into a clean, diagnosable exit:
+
+- `deadline(site)` — a context manager armed around every host-level
+  collective dispatch site. When `tpu_collective_timeout_s` expires
+  before the site returns, a daemon timer thread dumps per-thread
+  Python stacks (the PR 9 faulthandler style — they land even when the
+  main thread is wedged inside an XLA collective where no Python
+  bytecode can run), writes a structured `rank_failure_r<rank>.json`
+  evidence file + a `rank_failure` run-log event, and exits with
+  `RC_RANK_FAILURE` — a distinct rc the supervisor
+  (`scripts/elastic_smoke.py`) maps to "peer wedged, shrink the cohort
+  and resume". The heartbeat file is left at the rank's last PROGRESS
+  beat, so `failure.time - heartbeat.time` reads as detection latency.
+- a per-rank heartbeat LEASE: training heartbeats
+  (`telemetry.heartbeat`, written per grower dispatch and per
+  iteration) carry pid + the configured lease duration;
+  `read_cohort()` classifies every rank as alive / expired / failed
+  from the heartbeat + failure files alone, so an external supervisor
+  can tell WHICH rank died and why without talking to any process.
+
+The guard is free when disabled (timeout 0, the default): `deadline`
+yields immediately without creating a timer. When enabled, the cost is
+one `threading.Timer` create/cancel per dispatch — microseconds
+against a collective that moves megabytes.
+
+Compile time counts against the deadline: the first dispatch of a new
+shape traces + compiles under the guard (29-81 s on wide shapes), so
+`tpu_collective_timeout_s` must be set above the worst-case compile,
+not just the steady-state collective latency.
+"""
+from __future__ import annotations
+
+import contextlib
+import glob
+import json
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, Optional
+
+# distinct exit code: "this rank detected a wedged/dead peer (or was
+# itself wedged) inside a collective and shut down instead of hanging".
+# Chosen clear of the shell's 126/127/128+signal conventions and the
+# harness's rc-124 timeout.
+RC_RANK_FAILURE = 113
+
+# grace the acceptance contract allows past the deadline itself: stack
+# dump + evidence writes + exit must finish within it
+EXIT_GRACE_S = 10.0
+
+_state_lock = threading.Lock()
+_timeout_s: float = float(os.environ.get("LGBM_TPU_COLLECTIVE_TIMEOUT_S",
+                                         "0") or 0)
+_failure_dir: str = os.environ.get("LGBM_TPU_FAILURE_DIR", "")
+_lease_s: float = float(os.environ.get("LGBM_TPU_HEARTBEAT_LEASE_S",
+                                       "0") or 0)
+_rank: Optional[int] = None
+_expired = False   # one site wins; later expiries must not re-enter
+
+
+def configure(timeout_s: Optional[float] = None,
+              failure_dir: Optional[str] = None,
+              lease_s: Optional[float] = None,
+              rank: Optional[int] = None) -> None:
+    """Arm the watchdog for this process (idempotent; called from
+    GBDT.init with the run's config, and directly by harnesses). Only
+    non-None arguments change state."""
+    global _timeout_s, _failure_dir, _lease_s, _rank
+    with _state_lock:
+        if timeout_s is not None:
+            _timeout_s = max(0.0, float(timeout_s))
+        if failure_dir is not None:
+            _failure_dir = str(failure_dir)
+        if lease_s is not None:
+            _lease_s = max(0.0, float(lease_s))
+        if rank is not None:
+            _rank = int(rank)
+
+
+def collective_timeout_s() -> float:
+    return _timeout_s
+
+
+def lease_s() -> float:
+    return _lease_s
+
+
+def current_rank() -> int:
+    """This process's rank, without touching an uninitialized backend.
+    Precedence: the launcher's env var (set per child by supervisors —
+    authoritative for fault targeting even before any backend exists),
+    then the configured rank (GBDT.init), then a live-runtime probe
+    (jax.process_index only consulted when a backend already exists)."""
+    env = os.environ.get("LGBM_TPU_RANK", "")
+    if env:
+        try:
+            return int(env)
+        except ValueError:
+            pass
+    if _rank is not None:
+        return _rank
+    try:
+        import jax
+        from jax._src import distributed as _dist
+        if getattr(_dist.global_state, "client", None) is not None:
+            return jax.process_index()
+    except Exception:
+        pass
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# failure evidence
+# ---------------------------------------------------------------------------
+def failure_path(directory: str, rank: int) -> str:
+    return os.path.join(directory, f"rank_failure_r{rank}.json")
+
+
+def _dump_stacks(directory: str, rank: int) -> Optional[str]:
+    """Per-thread Python stacks at expiry time. faulthandler writes
+    through a raw fd, so the frames land even mid-C-call; stderr gets a
+    copy for log scrapers."""
+    import faulthandler
+    path = None
+    if directory:
+        path = os.path.join(directory, f"rank_failure_r{rank}.stacks.txt")
+        try:
+            with open(path, "w") as fh:
+                faulthandler.dump_traceback(file=fh, all_threads=True)
+        except OSError:
+            path = None
+    try:
+        sys.stderr.write(
+            f"[lightgbm_tpu] rank {rank}: collective watchdog expired; "
+            "per-thread stacks follow\n")
+        faulthandler.dump_traceback(file=sys.stderr, all_threads=True)
+        sys.stderr.flush()
+    except Exception:
+        pass
+    return path
+
+
+def _expire(site: str, timeout_s: float, iteration: Optional[int]) -> None:
+    """Timer-thread body: the guarded collective did not return within
+    its deadline. Leave every piece of evidence a post-mortem needs,
+    then exit with the distinct rc — the main thread is (by definition)
+    wedged and can never raise."""
+    global _expired
+    with _state_lock:
+        if _expired:
+            return
+        _expired = True
+    rank = current_rank()
+    directory = _failure_dir
+    stacks = _dump_stacks(directory, rank)
+    record = {
+        "kind": "rank_failure",
+        "rank": rank,
+        "pid": os.getpid(),
+        "site": site,
+        "timeout_s": timeout_s,
+        "iteration": iteration,
+        "time": time.time(),
+        "stacks_file": stacks,
+        "rc": RC_RANK_FAILURE,
+    }
+    if directory:
+        try:
+            os.makedirs(directory, exist_ok=True)
+            path = failure_path(directory, rank)
+            with open(path + ".tmp", "w") as fh:
+                json.dump(record, fh)
+            os.replace(path + ".tmp", path)
+        except OSError:
+            pass
+    # structured run-log event: best-effort — the evidence file above
+    # is the primary artifact. The heartbeat file is deliberately NOT
+    # touched: it must keep the rank's last PROGRESS beat, so
+    # `failure.time - heartbeat.time` reads as the detection latency
+    # (how long the rank was silently stuck before being declared dead)
+    try:
+        from .. import telemetry
+        rec = telemetry.active_recorder()
+        if rec is not None:
+            rec.event("rank_failure", site=site, rank=rank,
+                      timeout_s=timeout_s, iteration=iteration,
+                      rc=RC_RANK_FAILURE)
+    except Exception:
+        pass
+    try:
+        from .. import log
+        log.warning(
+            "Collective '%s' did not complete within %.1fs: a peer rank "
+            "is dead or wedged. Exiting with rc %d (evidence: %s)",
+            site, timeout_s, RC_RANK_FAILURE,
+            failure_path(directory, rank) if directory else "stderr")
+    except Exception:
+        pass
+    try:
+        sys.stderr.flush()
+        sys.stdout.flush()
+    except Exception:
+        pass
+    os._exit(RC_RANK_FAILURE)
+
+
+@contextlib.contextmanager
+def deadline(site: str, timeout_s: Optional[float] = None,
+             iteration: Optional[int] = None):
+    """Deadline guard for one host-level collective dispatch. A no-op
+    when the effective timeout is 0 (the default). Expiry does NOT
+    raise into the guarded code — it exits the process (see _expire):
+    a wedged collective cannot be unwound, only abandoned."""
+    t = _timeout_s if timeout_s is None else float(timeout_s)
+    if t <= 0:
+        yield
+        return
+    timer = threading.Timer(t, _expire, args=(site, t, iteration))
+    timer.daemon = True
+    timer.start()
+    try:
+        yield
+    finally:
+        timer.cancel()
+
+
+# ---------------------------------------------------------------------------
+# heartbeat-lease cohort view (supervisor side)
+# ---------------------------------------------------------------------------
+DEFAULT_LEASE_S = 60.0
+
+
+def read_cohort(directory: str, lease_s: Optional[float] = None,
+                now: Optional[float] = None) -> Dict[int, Dict[str, Any]]:
+    """Classify every rank with evidence under `directory`:
+
+    - "failed"  — a rank_failure_r<rank>.json exists (the rank's own
+      watchdog detected a wedge and exited with RC_RANK_FAILURE);
+    - "alive"   — heartbeat younger than the lease;
+    - "expired" — heartbeat older than the lease (SIGKILL / OOM / power
+      loss: the rank never got to say why it died).
+
+    `lease_s=None` reads each rank's own lease stamp out of its
+    heartbeat file (`tpu_heartbeat_lease_s`, written by
+    telemetry.heartbeat) — a supervisor needs no copy of the run's
+    config; pass an explicit value to override.
+
+    Returns {rank: {"status", "age_s", "iteration", "phase", ...}}."""
+    now = time.time() if now is None else now
+    out: Dict[int, Dict[str, Any]] = {}
+    for path in sorted(glob.glob(os.path.join(directory,
+                                              "heartbeat_r*.json"))):
+        try:
+            with open(path) as fh:
+                hb = json.load(fh)
+            rank = int(hb.get("rank", -1))
+        except (OSError, ValueError, json.JSONDecodeError):
+            continue
+        age = now - float(hb.get("time", now))
+        lease = lease_s if lease_s is not None \
+            else float(hb.get("lease_s", DEFAULT_LEASE_S))
+        out[rank] = {
+            "status": "alive" if age <= lease else "expired",
+            "age_s": round(age, 3),
+            "lease_s": lease,
+            "iteration": hb.get("iteration"),
+            "phase": hb.get("phase"),
+            "pid": hb.get("pid"),
+        }
+    for path in sorted(glob.glob(os.path.join(directory,
+                                              "rank_failure_r*.json"))):
+        try:
+            with open(path) as fh:
+                rec = json.load(fh)
+            rank = int(rec.get("rank", -1))
+        except (OSError, ValueError, json.JSONDecodeError):
+            continue
+        entry = out.setdefault(rank, {"age_s": None, "iteration": None,
+                                      "phase": None, "pid": rec.get("pid")})
+        entry["status"] = "failed"
+        entry["site"] = rec.get("site")
+        entry["failure_time"] = rec.get("time")
+    return out
+
+
+def dead_ranks(directory: str,
+               lease_s: Optional[float] = None) -> Dict[int, str]:
+    """{rank: status} for every rank that is not alive."""
+    return {r: info["status"]
+            for r, info in read_cohort(directory, lease_s).items()
+            if info["status"] != "alive"}
+
+
+def reset_for_tests() -> None:
+    """Test hook: forget configured state (NOT part of the public API)."""
+    global _timeout_s, _failure_dir, _lease_s, _rank, _expired
+    with _state_lock:
+        _timeout_s = 0.0
+        _failure_dir = ""
+        _lease_s = 0.0
+        _rank = None
+        _expired = False

@@ -34,11 +34,27 @@ from .runlog import (SCHEMA_VERSION, RunLog, TrainRecorder, read_records,
 __all__ = [
     "DEFAULT_TIME_BUCKETS", "Counter", "Gauge", "Histogram", "Registry",
     "RunLog", "TrainRecorder", "CompileObserver", "SCHEMA_VERSION",
-    "block", "counter_add", "current_site", "enable", "enabled",
-    "gauge_set", "heartbeat", "observe", "observer", "install_observer",
-    "registry", "reset", "read_records", "set_heartbeat_file", "span",
+    "active_recorder", "block", "counter_add", "current_site", "enable",
+    "enabled", "gauge_set", "heartbeat", "observe", "observer",
+    "install_observer", "registry", "reset", "read_records",
+    "set_active_recorder", "set_heartbeat_file", "span",
     "start_run", "validate_record", "dump",
 ]
+
+# the recorder of the training run currently in flight (engine.train
+# installs/clears it): lets out-of-band reporters — the collective
+# watchdog's expiry path above all — append structured events to the
+# run log without plumbing a recorder reference through every layer
+_ACTIVE_RECORDER: Optional["TrainRecorder"] = None
+
+
+def set_active_recorder(rec: Optional["TrainRecorder"]) -> None:
+    global _ACTIVE_RECORDER
+    _ACTIVE_RECORDER = rec
+
+
+def active_recorder() -> Optional["TrainRecorder"]:
+    return _ACTIVE_RECORDER
 
 
 def start_run(gbdt, params: Dict[str, Any]) -> Optional[TrainRecorder]:
@@ -70,8 +86,13 @@ def start_run(gbdt, params: Dict[str, Any]) -> Optional[TrainRecorder]:
         run_log = RunLog(directory, rank=rank)
 
     from .. import checkpoint as ckpt
+    # global rows, matching engine._setup_checkpointing: the run-log
+    # header's fingerprint must stay stable across world sizes so an
+    # elastically-resumed run's trail chains to the original's
+    n_fp = int(getattr(getattr(gbdt, "train_data", None),
+                       "num_global_rows", 0) or getattr(gbdt, "_n", 0))
     fingerprint = ckpt.config_fingerprint(
-        cfg.raw_params, int(getattr(gbdt, "_n", 0)),
+        cfg.raw_params, n_fp,
         int(getattr(gbdt, "max_feature_idx", -1)) + 1, cfg.boosting_type)
     rec = TrainRecorder(gbdt, run_log, rank=rank, world=world,
                         fingerprint=fingerprint, params=params,

@@ -179,13 +179,22 @@ def write_cross_rank_aggregate(directory: str, rank: int,
                                world: int) -> Optional[str]:
     """End-of-run collective: every rank contributes its snapshot, rank 0
     writes `metrics_aggregate.prom`. Must be called by ALL ranks (it is
-    an allgather). Returns the written path on rank 0, None elsewhere."""
+    an allgather). Returns the written path on rank 0, None elsewhere.
+
+    Deadline-guarded under its own site label: a rank that died during
+    training must not convert the END of every survivor's run into an
+    indefinite hang inside Prometheus export — with
+    `tpu_collective_timeout_s` set, survivors exit RC_RANK_FAILURE with
+    a `telemetry.aggregate`-sited rank_failure event instead."""
     import os
 
     from ..parallel.multihost import allgather_bytes
     blob = json.dumps(metrics_mod.registry().snapshot(),
                       sort_keys=True).encode("utf-8")
-    blobs = allgather_bytes(blob)
+    # one guard, distinctly labeled: allgather_bytes arms its own
+    # deadline under the site passed here (a second outer timer would
+    # race it and make the recorded failure site nondeterministic)
+    blobs = allgather_bytes(blob, site="telemetry.aggregate")
     if rank != 0:
         return None
     snaps = []

@@ -374,28 +374,51 @@ def set_heartbeat_file(path: str) -> None:
     _HEARTBEAT_FILE = path or ""
 
 
+def heartbeat_file() -> str:
+    return _HEARTBEAT_FILE
+
+
 def heartbeat(iteration: int, phase: str = "train",
               rank: Optional[int] = None) -> None:
     """Record liveness: a gauge (when telemetry is on) and — when a
     heartbeat file is armed (LGBM_TPU_HEARTBEAT_FILE, set per rank by
-    watchdog harnesses like scripts/dryrun_multichip.py) — an atomically
-    replaced one-line JSON file carrying (rank, iteration, phase, time),
-    the artifact a timed-out run's parent reads to say WHERE each rank
-    was. File writes are plain write+rename (no fsync: evidence, not
+    watchdog harnesses like scripts/dryrun_multichip.py, or derived
+    from tpu_heartbeat_dir) — an atomically replaced one-line JSON file
+    carrying (rank, iteration, phase, time, pid, lease_s), the artifact
+    a timed-out run's parent reads to say WHERE each rank was. The
+    lease stamp lets any reader (`parallel.watchdog.read_cohort`)
+    classify the rank alive/expired without knowing the run's config.
+    File writes are plain write+rename (no fsync: evidence, not
     durability)."""
     if _enabled:
         _registry.gauge("heartbeat/iteration",
                         {"phase": phase}).set(float(iteration))
     if _HEARTBEAT_FILE:
         import json
-        if rank is None:
-            rank = int(os.environ.get("LGBM_TPU_RANK", "0") or 0)
+        lease = 0.0
+        try:
+            from ..parallel import watchdog as _wd
+            if rank is None:
+                # watchdog.current_rank, NOT the raw env var: under
+                # machine-list / explicit-param launches the rank is
+                # resolved inside init_distributed and configured by
+                # GBDT.init — the env default of 0 would stamp every
+                # rank's heartbeat as rank 0 and collapse the
+                # supervisor's cohort view into one entry
+                rank = _wd.current_rank()
+            lease = _wd.lease_s()
+        except Exception:  # pragma: no cover — import-order edge
+            if rank is None:
+                rank = int(os.environ.get("LGBM_TPU_RANK", "0") or 0)
+        rec = {"rank": int(rank), "iteration": int(iteration),
+               "phase": str(phase), "time": time.time(),
+               "pid": os.getpid()}
+        if lease > 0:
+            rec["lease_s"] = lease
         tmp = _HEARTBEAT_FILE + ".tmp"
         try:
             with open(tmp, "w") as fh:
-                fh.write(json.dumps({
-                    "rank": int(rank), "iteration": int(iteration),
-                    "phase": str(phase), "time": time.time()}) + "\n")
+                fh.write(json.dumps(rec) + "\n")
             os.replace(tmp, _HEARTBEAT_FILE)
         except OSError:
             pass  # liveness reporting must never kill the run

@@ -282,9 +282,43 @@ class TrainRecorder:
             self.run_log = None
 
     def close(self, status: str = "finished") -> None:
-        """Summary record + Prometheus dump + cross-rank aggregation."""
+        """Prometheus dump + cross-rank aggregation + summary record.
+
+        The aggregate collective runs BEFORE the summary is written and
+        the log closed: it can wedge on a peer that died late, and the
+        collective watchdog's rank_failure event must still have an
+        OPEN run log to land in (the log of a run that died there
+        correctly ends with the rank_failure event, no summary)."""
         if self.disable_on_close:
             metrics_mod.enable(False)
+        if self._directory and self.prometheus:
+            from . import export
+            # per-rank file write and the cross-rank collective are
+            # isolated from each other: a local write failure on one
+            # rank must NOT skip its allgather participation, or every
+            # other rank blocks in write_cross_rank_aggregate at end of
+            # training
+            try:
+                export.write_prometheus(
+                    os.path.join(self._directory,
+                                 f"metrics_r{self.rank}.prom"),
+                    extra_labels={"rank": str(self.rank)})
+            except Exception as exc:  # export is best-effort narration
+                log.warning("Telemetry export failed: %s", exc)
+            # the aggregate is a COLLECTIVE: only run it on clean
+            # finishes, when every rank reaches close() together. On an
+            # error close the other ranks are still inside training
+            # collectives — joining an allgather here would mismatch
+            # them and wedge the job that was about to exit with a
+            # diagnosable error.
+            if self.world > 1 and status == "finished":
+                try:
+                    export.write_cross_rank_aggregate(self._directory,
+                                                      self.rank,
+                                                      self.world)
+                except Exception as exc:
+                    log.warning("Cross-rank telemetry aggregation "
+                                "failed: %s", exc)
         reg = metrics_mod.registry()
         summary = {
             "type": "summary", "status": status,
@@ -301,28 +335,3 @@ class TrainRecorder:
             except (OSError, ValueError):  # pragma: no cover
                 pass
             self.run_log.close()
-        if not (self._directory and self.prometheus):
-            return
-        from . import export
-        # per-rank file write and the cross-rank collective are isolated
-        # from each other: a local write failure on one rank must NOT
-        # skip its allgather participation, or every other rank blocks
-        # in write_cross_rank_aggregate at end of training
-        try:
-            export.write_prometheus(
-                os.path.join(self._directory, f"metrics_r{self.rank}.prom"),
-                extra_labels={"rank": str(self.rank)})
-        except Exception as exc:  # export is best-effort narration
-            log.warning("Telemetry export failed: %s", exc)
-        # the aggregate is a COLLECTIVE: only run it on clean finishes,
-        # when every rank reaches close() together. On an error close
-        # the other ranks are still inside training collectives — joining
-        # an allgather here would mismatch them and wedge the job that
-        # was about to exit with a diagnosable error.
-        if self.world > 1 and status == "finished":
-            try:
-                export.write_cross_rank_aggregate(self._directory,
-                                                  self.rank, self.world)
-            except Exception as exc:
-                log.warning("Cross-rank telemetry aggregation failed: %s",
-                            exc)

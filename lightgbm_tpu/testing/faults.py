@@ -1,10 +1,12 @@
-"""Fault-injection harness for preemption/IO robustness testing.
+"""Fault-injection harness for preemption/IO/distributed robustness.
 
 TPU pods are preemptible: a long boosting run can die at any iteration,
 an NFS checkpoint write can fail halfway, a collective can be severed by
-a restarting worker. This module simulates those failures
-deterministically so the checkpoint/resume subsystem
-(`lightgbm_tpu/checkpoint.py`) can be tested at tier-1 speed:
+a restarting worker — or simply WEDGE when a peer stops answering. This
+module simulates those failures deterministically so the
+checkpoint/resume subsystem (`lightgbm_tpu/checkpoint.py`) and the
+collective watchdogs (`lightgbm_tpu/parallel/watchdog.py`) can be tested
+at tier-1 speed:
 
 - `active(kill_at_iteration=23)` — raise `SimulatedPreemption` when the
   training loop reaches iteration 23 (after 23 completed iterations),
@@ -12,10 +14,24 @@ deterministically so the checkpoint/resume subsystem
 - `active(fail={"checkpoint.write": 2})` — the next 2 calls that pass
   through the named injection site raise `InjectedFault`; sites are
   instrumented in checkpoint IO (`checkpoint.write`, `checkpoint.rename`,
-  `checkpoint.read`), the boosting backend (`backend.grow`) and the
-  distributed learners (`collective.call`).
+  `checkpoint.read`), the boosting backend (`backend.grow`), the
+  distributed learners (`collective.call`) and the multihost collectives
+  (`multihost.allgather`, `multihost.agree`).
+- distributed fault shapes (ISSUE 11): `kill_rank(rank, at_iteration)`
+  preempts only the named rank; `wedge_collective(site, seconds)` makes
+  the next call through `site` BLOCK for `seconds` (the "peer stopped
+  answering" shape the collective watchdog must convert into a clean
+  `RC_RANK_FAILURE` exit); `fail_next_collective(n)` fails the next n
+  grower dispatches.
 - `corrupt_file` / `truncate_file` — bit-flip or cut a checkpoint on
   disk to exercise the checksum-validation / fall-back-to-previous path.
+
+Child processes arm plans through the `LGBM_TPU_FAULT_PLAN` env var — a
+JSON object with the same fields as `FaultPlan`
+(`{"kill_at_iteration": 5, "wedge": {"collective.call": 30},
+"fail": {...}, "kill_rank": [1, 5]}`) — which is how the elastic
+supervisor (`scripts/elastic_smoke.py`) injects failures into ranks it
+launches.
 
 Instrumented code calls `inject(site)` which is a no-op (one `is None`
 check) unless a plan is active, so production runs pay nothing.
@@ -23,8 +39,10 @@ check) unless a plan is active, so production runs pay nothing.
 from __future__ import annotations
 
 import contextlib
+import json
 import os
-from typing import Dict, List, Optional
+import time
+from typing import Dict, List, Optional, Tuple
 
 
 class InjectedFault(RuntimeError):
@@ -45,16 +63,53 @@ class SimulatedPreemption(Exception):
 
 
 class FaultPlan:
-    """One active injection schedule (install via `active()`)."""
+    """One active injection schedule (install via `active()` or the
+    module-level distributed-shape helpers)."""
 
     def __init__(self, kill_at_iteration: Optional[int] = None,
-                 fail: Optional[Dict[str, int]] = None):
+                 fail: Optional[Dict[str, int]] = None,
+                 wedge: Optional[Dict[str, float]] = None,
+                 kill_rank: Optional[Tuple[int, int]] = None):
         self.kill_at_iteration = kill_at_iteration
         self.fail = dict(fail or {})
+        # site -> seconds: the next call through the site sleeps (once)
+        self.wedge = {k: float(v) for k, v in (wedge or {}).items()}
+        # (rank, at_iteration): preempt only that rank
+        self.kill_rank = tuple(kill_rank) if kill_rank else None
         self.fired: List[str] = []   # audit log of injected faults
 
 
 _plan: Optional[FaultPlan] = None
+_env_checked = False
+
+FAULT_PLAN_ENV = "LGBM_TPU_FAULT_PLAN"
+
+
+def _current_rank() -> int:
+    # one source of truth for rank discovery (env var, configured rank,
+    # live-runtime probe): the collective watchdog's
+    from ..parallel.watchdog import current_rank
+    return current_rank()
+
+
+def _load_env_plan() -> None:
+    """Install a persistent plan from LGBM_TPU_FAULT_PLAN (checked once,
+    on the first inject call with no in-process plan armed)."""
+    global _plan, _env_checked
+    _env_checked = True
+    spec = os.environ.get(FAULT_PLAN_ENV, "")
+    if not spec:
+        return
+    try:
+        d = json.loads(spec)
+        _plan = FaultPlan(
+            kill_at_iteration=d.get("kill_at_iteration"),
+            fail=d.get("fail"),
+            wedge=d.get("wedge"),
+            kill_rank=d.get("kill_rank"))
+    except (ValueError, TypeError) as exc:
+        raise ValueError(
+            f"Unparseable {FAULT_PLAN_ENV}: {spec!r} ({exc})") from exc
 
 
 def inject(site: str, iteration: Optional[int] = None) -> None:
@@ -62,13 +117,29 @@ def inject(site: str, iteration: Optional[int] = None) -> None:
     unless a plan is active. `iteration` is only consulted by the
     `train.iteration` site (the engine loop's preemption point)."""
     if _plan is None:
-        return
-    if (site == "train.iteration"
-            and _plan.kill_at_iteration is not None
-            and iteration is not None
-            and iteration >= _plan.kill_at_iteration):
-        _plan.fired.append(f"kill@{iteration}")
-        raise SimulatedPreemption(iteration)
+        if _env_checked:
+            return
+        _load_env_plan()
+        if _plan is None:
+            return
+    if site == "train.iteration" and iteration is not None:
+        if (_plan.kill_at_iteration is not None
+                and iteration >= _plan.kill_at_iteration):
+            _plan.fired.append(f"kill@{iteration}")
+            raise SimulatedPreemption(iteration)
+        if (_plan.kill_rank is not None
+                and iteration >= _plan.kill_rank[1]
+                and _current_rank() == _plan.kill_rank[0]):
+            _plan.fired.append(
+                f"kill_rank{_plan.kill_rank[0]}@{iteration}")
+            raise SimulatedPreemption(iteration)
+    secs = _plan.wedge.pop(site, None)
+    if secs is not None:
+        # the wedge shape: the call BLOCKS (peer stopped answering) —
+        # one-shot, so a watchdog-less run eventually continues and a
+        # watchdog-armed run has exactly one deadline violation to catch
+        _plan.fired.append(f"wedge@{site}")
+        time.sleep(secs)
     remaining = _plan.fail.get(site, 0)
     if remaining > 0:
         _plan.fail[site] = remaining - 1
@@ -78,20 +149,56 @@ def inject(site: str, iteration: Optional[int] = None) -> None:
 
 @contextlib.contextmanager
 def active(kill_at_iteration: Optional[int] = None,
-           fail: Optional[Dict[str, int]] = None):
+           fail: Optional[Dict[str, int]] = None,
+           wedge: Optional[Dict[str, float]] = None,
+           kill_rank: Optional[Tuple[int, int]] = None):
     """Arm a fault plan for the duration of the with-block."""
     global _plan
     prev = _plan
-    _plan = FaultPlan(kill_at_iteration=kill_at_iteration, fail=fail)
+    _plan = FaultPlan(kill_at_iteration=kill_at_iteration, fail=fail,
+                      wedge=wedge, kill_rank=kill_rank)
     try:
         yield _plan
     finally:
         _plan = prev
 
 
-def reset() -> None:
+def _ensure_plan() -> FaultPlan:
     global _plan
+    if _plan is None:
+        _plan = FaultPlan()
+    return _plan
+
+
+def kill_rank(rank: int, at_iteration: int) -> FaultPlan:
+    """Preempt ONLY the named rank when its training loop reaches
+    `at_iteration` (other ranks keep running — and block in their next
+    collective, which is what the watchdog exists to catch)."""
+    plan = _ensure_plan()
+    plan.kill_rank = (int(rank), int(at_iteration))
+    return plan
+
+
+def wedge_collective(site: str, seconds: float) -> FaultPlan:
+    """Make the next call through `site` block for `seconds` (e.g.
+    "collective.call" for the grower dispatch, "multihost.allgather" /
+    "multihost.agree" for the host-level collectives)."""
+    plan = _ensure_plan()
+    plan.wedge[str(site)] = float(seconds)
+    return plan
+
+
+def fail_next_collective(n: int) -> FaultPlan:
+    """Fail the next `n` grower collective dispatches."""
+    plan = _ensure_plan()
+    plan.fail["collective.call"] = plan.fail.get("collective.call", 0) + int(n)
+    return plan
+
+
+def reset() -> None:
+    global _plan, _env_checked
     _plan = None
+    _env_checked = True  # an explicit reset also disarms the env plan
 
 
 # ---------------------------------------------------------------------------

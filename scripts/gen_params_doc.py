@@ -76,6 +76,14 @@ DESCRIPTIONS = {
                            "are rotated out; corrupt/truncated "
                            "snapshots fall back to the previous good "
                            "one on resume)",
+    "tpu_elastic_resume": "accept checkpoints taken at a DIFFERENT "
+                          "world size: scores re-shard onto the new "
+                          "device/process layout; across DEVICE-count "
+                          "changes the resumed model is byte-identical "
+                          "to an uninterrupted run (process-count "
+                          "changes restore exact state but f32 "
+                          "summation order differs). false = refuse "
+                          "world-size changes",
     "tpu_telemetry_dir": "observability directory: a structured JSONL "
                          "run log (header + one record per iteration + "
                          "events + summary; see README Observability) "
@@ -249,6 +257,24 @@ DESCRIPTIONS = {
     "time_out": "kept for API compat",
     "machine_list_filename": "host list file (rank order)",
     "machines": "inline comma-separated host list",
+    "tpu_collective_timeout_s": "deadline for every host-level "
+                                "collective dispatch: on expiry the "
+                                "rank dumps per-thread stacks + a "
+                                "rank_failure event and exits rc 113 "
+                                "instead of hanging on a dead peer "
+                                "(0 = off; must exceed worst-case "
+                                "compile time — the first dispatch of "
+                                "a new shape compiles under the guard)",
+    "tpu_heartbeat_dir": "per-rank liveness directory: "
+                         "heartbeat_r<rank>.json on every dispatch/"
+                         "iteration, rank_failure_r<rank>.json on "
+                         "watchdog expiry — what an external "
+                         "supervisor reads to tell which rank died "
+                         "and why",
+    "tpu_heartbeat_lease_s": "heartbeat lease: a supervisor declares a "
+                             "rank dead when its heartbeat is older "
+                             "than this (stamped into the heartbeat "
+                             "file)",
 }
 
 def main():

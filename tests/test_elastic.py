@@ -1,0 +1,486 @@
+"""Elastic multi-process training (ISSUE 11): collective watchdogs,
+rank-failure detection, and world-size-elastic checkpoint resume.
+
+Three layers:
+
+- watchdog unit tests — the deadline guard is free when disabled, and a
+  deliberately wedged fake collective in a CHILD process must exit with
+  `RC_RANK_FAILURE` (not hang), leaving the rank_failure evidence files.
+- the acceptance forced-wedge test — a wedged grower dispatch inside a
+  real training run exits within `tpu_collective_timeout_s` + grace with
+  per-thread stacks and a `rank_failure` run-log event.
+- world-size-elastic resume — a W=4-device snapshot restores at W'=2 and
+  W'=1 WITHOUT refusal, and the kill-at-k -> shrink -> resume cycle
+  yields a final model byte-identical to the uninterrupted serial run,
+  on both the scatter and allreduce histogram-merge paths (device
+  counts are forced per CHILD process, the test_scatter_reduce
+  discipline: the in-process backend is pinned to one CPU device).
+  The multi-process (rank-count) reassembly logic is covered backend-
+  free via fabricated rank snapshot sets.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import checkpoint as ckpt_mod
+from lightgbm_tpu.parallel import watchdog
+from lightgbm_tpu.testing import faults
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# watchdog unit layer
+# ---------------------------------------------------------------------------
+def test_deadline_disabled_is_noop():
+    watchdog.reset_for_tests()
+    with watchdog.deadline("any.site"):          # timeout 0: no timer
+        pass
+    with watchdog.deadline("any.site", timeout_s=30.0):
+        pass                                     # fast body: timer cancelled
+
+
+def test_read_cohort_classifies_alive_expired_failed(tmp_path):
+    now = time.time()
+    d = str(tmp_path)
+    with open(os.path.join(d, "heartbeat_r0.json"), "w") as fh:
+        json.dump({"rank": 0, "iteration": 7, "phase": "train",
+                   "time": now - 1.0, "pid": 1}, fh)
+    with open(os.path.join(d, "heartbeat_r1.json"), "w") as fh:
+        json.dump({"rank": 1, "iteration": 3, "phase": "grower_dispatch",
+                   "time": now - 120.0, "pid": 2}, fh)
+    with open(os.path.join(d, "rank_failure_r2.json"), "w") as fh:
+        json.dump({"rank": 2, "site": "collective.dispatch",
+                   "time": now - 5.0, "pid": 3}, fh)
+    cohort = watchdog.read_cohort(d, lease_s=10.0, now=now)
+    assert cohort[0]["status"] == "alive"
+    assert cohort[0]["iteration"] == 7
+    assert cohort[1]["status"] == "expired"
+    assert cohort[2]["status"] == "failed"
+    assert cohort[2]["site"] == "collective.dispatch"
+    assert watchdog.dead_ranks(d, 10.0).keys() == {1, 2}
+
+
+WEDGED_FAKE_COLLECTIVE = r"""
+import sys, time
+sys.path.insert(0, {repo!r})
+from lightgbm_tpu.parallel import watchdog
+watchdog.configure(timeout_s=1.0, failure_dir={evidence!r}, lease_s=5.0,
+                   rank=0)
+with watchdog.deadline("fake.collective"):
+    time.sleep(120)
+print("UNREACHABLE")
+"""
+
+
+def test_watchdog_expiry_exits_wedged_child_with_distinct_rc(tmp_path):
+    """A deliberately wedged fake collective: the child must exit with
+    RC_RANK_FAILURE well within timeout + grace, leaving the structured
+    failure record and a per-thread stack dump."""
+    evidence = str(tmp_path)
+    t0 = time.time()
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         WEDGED_FAKE_COLLECTIVE.format(repo=REPO, evidence=evidence)],
+        capture_output=True, text=True, timeout=60)
+    elapsed = time.time() - t0
+    assert proc.returncode == watchdog.RC_RANK_FAILURE, proc.stderr[-500:]
+    assert "UNREACHABLE" not in proc.stdout
+    assert elapsed < 1.0 + watchdog.EXIT_GRACE_S + 20, elapsed
+    with open(os.path.join(evidence, "rank_failure_r0.json")) as fh:
+        rec = json.load(fh)
+    assert rec["site"] == "fake.collective"
+    assert rec["rc"] == watchdog.RC_RANK_FAILURE
+    stacks = open(os.path.join(evidence,
+                               "rank_failure_r0.stacks.txt")).read()
+    assert "Thread" in stacks or "File" in stacks
+    # the expiry narration also reaches stderr for log scrapers
+    assert "watchdog expired" in proc.stderr
+
+
+TRAIN_CHILD = r"""
+import json, os, sys
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, {repo!r})
+import lightgbm_tpu as lgb
+from lightgbm_tpu.testing import faults
+
+spec = json.loads(os.environ["ELASTIC_TEST_SPEC"])
+raw = np.load(spec["data"])
+X, y = raw[:, 1:], raw[:, 0]
+try:
+    booster = lgb.train(spec["params"], lgb.Dataset(X, y),
+                        num_boost_round=spec["rounds"],
+                        verbose_eval=False)
+except faults.SimulatedPreemption as exc:
+    print("CHILD_PREEMPTED", exc.iteration, flush=True)
+    sys.exit(77)
+with open(spec["out"], "w") as fh:
+    fh.write(booster.model_to_string())
+print("CHILD_OK", flush=True)
+"""
+
+
+def _spawn_train_child(ndev, spec, fault_plan=None):
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + f" --xla_force_host_platform_device_count={ndev}"
+                        ).strip()
+    env["ELASTIC_TEST_SPEC"] = json.dumps(spec)
+    env.pop("LGBM_TPU_FAULT_PLAN", None)
+    if fault_plan:
+        env["LGBM_TPU_FAULT_PLAN"] = json.dumps(fault_plan)
+    return subprocess.Popen(
+        [sys.executable, "-c", TRAIN_CHILD.format(repo=REPO)],
+        env=env, cwd=REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+
+
+class _Done:
+    def __init__(self, returncode, stdout, stderr):
+        self.returncode, self.stdout, self.stderr = \
+            returncode, stdout, stderr
+
+
+def _wait_child(proc, timeout=180):
+    try:
+        out, err = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        raise
+    return _Done(proc.returncode, out, err)
+
+
+def _run_train_child(ndev, spec, fault_plan=None, timeout=180):
+    return _wait_child(_spawn_train_child(ndev, spec, fault_plan),
+                       timeout)
+
+
+def _make_data(tmp_path, n=600, f=6, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = (X[:, 0] + X[:, 1] * X[:, 2] + 0.3 * rng.randn(n) > 0).astype(
+        np.float64)
+    path = str(tmp_path / "data.npy")
+    np.save(path, np.column_stack([y, X]))
+    return path, X, y
+
+
+# ---------------------------------------------------------------------------
+# the acceptance forced-wedge test: a wedged rank never hangs training
+# ---------------------------------------------------------------------------
+def test_wedged_grower_dispatch_exits_with_rank_failure_event(tmp_path):
+    data_path, _, _ = _make_data(tmp_path)
+    hb_dir = str(tmp_path / "hb")
+    tel_dir = str(tmp_path / "tel")
+    spec = {
+        "data": data_path, "rounds": 8,
+        "out": str(tmp_path / "never.txt"),
+        "params": {
+            "objective": "binary", "verbose": -1, "num_leaves": 7,
+            "tree_learner": "data", "tpu_hist_chunk": 64,
+            "tpu_collective_timeout_s": 2.0,
+            "tpu_heartbeat_dir": hb_dir,
+            "tpu_heartbeat_lease_s": 5.0,
+            "tpu_telemetry_dir": tel_dir,
+        },
+    }
+    t0 = time.time()
+    # 1 forced device: the wedge fires at the dispatch site regardless
+    # of device count (tree_learner=data routes through the grower
+    # either way) and the smaller mesh compiles faster — wall budget
+    proc = _run_train_child(
+        1, spec, fault_plan={"wedge": {"collective.call": 120}})
+    elapsed = time.time() - t0
+    assert proc.returncode == watchdog.RC_RANK_FAILURE, \
+        (proc.returncode, proc.stderr[-800:])
+    # "within tpu_collective_timeout_s + grace": generous slack for
+    # interpreter start + jit compile, but nowhere near the 120s wedge
+    assert elapsed < 60, elapsed
+    with open(os.path.join(hb_dir, "rank_failure_r0.json")) as fh:
+        rec = json.load(fh)
+    assert rec["site"] == "collective.dispatch"
+    stacks = open(os.path.join(
+        hb_dir, "rank_failure_r0.stacks.txt")).read()
+    assert stacks.strip(), "stack dump missing"
+    # structured rank_failure event in the run log
+    from lightgbm_tpu.telemetry import read_records
+    records = read_records(os.path.join(tel_dir, "runlog_r0.jsonl"))
+    events = [r for r in records if r.get("type") == "event"
+              and r.get("kind") == "rank_failure"]
+    assert events and events[0]["site"] == "collective.dispatch"
+    assert events[0]["rc"] == watchdog.RC_RANK_FAILURE
+
+
+def test_wedged_multihost_allgather_trips_watchdog(tmp_path):
+    """The telemetry-export satellite: a dead rank must not hang the
+    cross-rank Prometheus aggregation either — allgather_bytes carries
+    the same guard."""
+    child = r"""
+import sys, time
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+from lightgbm_tpu.parallel import watchdog
+from lightgbm_tpu.parallel.multihost import allgather_bytes
+from lightgbm_tpu.testing import faults
+watchdog.configure(timeout_s=1.0, failure_dir={evidence!r}, rank=0)
+faults.wedge_collective("multihost.allgather", 120)
+allgather_bytes(b"snapshot")
+print("UNREACHABLE")
+""".format(repo=REPO, evidence=str(tmp_path))
+    proc = subprocess.run([sys.executable, "-c", child],
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == watchdog.RC_RANK_FAILURE, proc.stderr[-500:]
+    with open(os.path.join(str(tmp_path), "rank_failure_r0.json")) as fh:
+        assert json.load(fh)["site"] == "multihost.allgather_bytes"
+
+
+# ---------------------------------------------------------------------------
+# world-size-elastic resume
+# ---------------------------------------------------------------------------
+def test_elastic_restore_accepts_different_pad_in_process(tmp_path):
+    """A snapshot whose score block is padded for a DIFFERENT world must
+    restore without refusal and stay byte-identical (the re-pad branch
+    of GBDT.restore_state, exercised without forcing device counts)."""
+    _, X, y = _make_data(tmp_path, n=300)
+    params = {"objective": "binary", "verbose": -1, "num_leaves": 7,
+              "bagging_fraction": 0.7, "bagging_freq": 1, "seed": 3}
+    expected = lgb.train(params, lgb.Dataset(X, y), num_boost_round=10,
+                         verbose_eval=False).model_to_string()
+    d = str(tmp_path / "ck")
+    p = dict(params, tpu_checkpoint_dir=d, tpu_checkpoint_interval=1)
+    with faults.active(kill_at_iteration=6):
+        with pytest.raises(faults.SimulatedPreemption):
+            lgb.train(p, lgb.Dataset(X, y), num_boost_round=10,
+                      verbose_eval=False)
+    manager = ckpt_mod.CheckpointManager(d)
+    payload, _ = manager.load_latest()
+    assert payload["state"]["num_data"] == 300
+    assert payload["state"]["world"]["processes"] == 1
+    # simulate a snapshot from a wider world: extra padding columns of
+    # garbage that the elastic restore must slice away
+    score = ckpt_mod.decode_array(payload["state"]["score"])
+    wide = np.concatenate(
+        [score, np.full((score.shape[0], 64), 1e30, np.float32)], axis=1)
+    payload["state"]["score"] = ckpt_mod.encode_array(wide)
+    payload["state"]["world"] = {"processes": 1, "rank": 0,
+                                 "devices": 4, "n_pad": wide.shape[1]}
+    manager.save(payload, payload["iteration"])
+    resumed = lgb.train(p, lgb.Dataset(X, y), num_boost_round=10,
+                        verbose_eval=False)
+    assert resumed.model_to_string() == expected
+
+
+def test_elastic_refused_when_disabled(tmp_path):
+    _, X, y = _make_data(tmp_path, n=300)
+    params = {"objective": "binary", "verbose": -1, "num_leaves": 7,
+              "seed": 3}
+    d = str(tmp_path / "ck")
+    p = dict(params, tpu_checkpoint_dir=d, tpu_checkpoint_interval=1)
+    with faults.active(kill_at_iteration=4):
+        with pytest.raises(faults.SimulatedPreemption):
+            lgb.train(p, lgb.Dataset(X, y), num_boost_round=8,
+                      verbose_eval=False)
+    manager = ckpt_mod.CheckpointManager(d)
+    payload, _ = manager.load_latest()
+    score = ckpt_mod.decode_array(payload["state"]["score"])
+    wide = np.concatenate(
+        [score, np.zeros((score.shape[0], 64), np.float32)], axis=1)
+    payload["state"]["score"] = ckpt_mod.encode_array(wide)
+    manager.save(payload, payload["iteration"])
+    with pytest.raises(lgb.basic.LightGBMError, match="score shape"):
+        lgb.train(dict(p, tpu_elastic_resume=False), lgb.Dataset(X, y),
+                  num_boost_round=8, verbose_eval=False)
+
+
+def test_kill_shrink_resume_4_2_1_byte_identical(tmp_path):
+    """The ISSUE acceptance cycle: kill at W=4 devices, elastic resume
+    at W'=2 (killed again), finish at W'=1 — final model byte-identical
+    to the uninterrupted serial run, bagging on, for BOTH histogram-
+    merge collectives (scatter and allreduce run their cycles
+    concurrently — independent checkpoint dirs — to stay inside the
+    tier-1 wall budget). Device counts are forced per child process."""
+    data_path, X, y = _make_data(tmp_path)
+    rounds = 12
+    base = {"objective": "binary", "verbose": -1, "num_leaves": 7,
+            "tree_learner": "data", "tpu_hist_chunk": 64,
+            "bagging_fraction": 0.7, "bagging_freq": 1, "seed": 11}
+    variants = {}
+    for mode in ("scatter", "allreduce"):
+        p = dict(base, tpu_hist_reduce=mode,
+                 tpu_checkpoint_dir=str(tmp_path / f"ck_{mode}"),
+                 tpu_checkpoint_interval=1, tpu_checkpoint_keep=50)
+        variants[mode] = lambda out_name, p=p, mode=mode: {
+            "data": data_path, "params": p, "rounds": rounds,
+            "out": str(tmp_path / f"{mode}_{out_name}")}
+
+    def stage(ndev, out_name, fault_plan, expect_rc):
+        procs = {m: _spawn_train_child(ndev, spec(out_name), fault_plan)
+                 for m, spec in variants.items()}
+        for m, proc in procs.items():
+            done = _wait_child(proc)
+            assert done.returncode == expect_rc, \
+                (m, done.returncode, done.stderr[-800:])
+            yield m, done
+
+    # stage-1 children launched FIRST, then the uninterrupted reference
+    # trains in-process while they run (wall-budget discipline). ONE
+    # reference serves both variants: a 1-shard mesh has nothing to
+    # scatter, so tpu_hist_reduce cannot change the serial model
+    # (parallel/learners.py forces allreduce)
+    stage1 = {m: _spawn_train_child(4, spec("w4.txt"),
+                                    {"kill_at_iteration": 5})
+              for m, spec in variants.items()}
+    expected = lgb.train(base, lgb.Dataset(X, y),
+                         num_boost_round=rounds,
+                         verbose_eval=False).model_to_string()
+    for m, proc in stage1.items():
+        done = _wait_child(proc)
+        assert done.returncode == 77, (m, done.returncode,
+                                       done.stderr[-800:])
+    for m, done in stage(2, "w2.txt", {"kill_at_iteration": 9}, 77):
+        assert "Resumed training" in done.stderr, m
+    list(stage(1, "final.txt", None, 0))
+    for mode in variants:
+        final = open(str(tmp_path / f"{mode}_final.txt")).read()
+        assert final == expected, \
+            f"elastically-resumed {mode} model differs from the " \
+            "uninterrupted run"
+
+
+# ---------------------------------------------------------------------------
+# multi-process (rank-count) reassembly — backend-free unit layer
+# ---------------------------------------------------------------------------
+def _fake_rank_payloads(n_global=40, k=1, world=4, seed=5):
+    """Fabricate a W-rank snapshot set over a known global score."""
+    rng = np.random.RandomState(seed)
+    global_score = rng.randn(k, n_global).astype(np.float32)
+    owner = rng.randint(0, world, size=n_global)
+    payloads = {}
+    for r in range(world):
+        gidx = np.nonzero(owner == r)[0].astype(np.int64)
+        n_local = len(gidx)
+        pad = n_local + 8  # per-rank padding, as a real snapshot has
+        score = np.zeros((k, pad), np.float32)
+        score[:, :n_local] = global_score[:, gidx]
+        payloads[r] = {
+            "iteration": 6,
+            "state": {
+                "score": ckpt_mod.encode_array(score),
+                "num_data": n_local,
+                "row_index": ckpt_mod.encode_array(gidx),
+                "world": {"processes": world, "rank": r,
+                          "devices": world, "n_pad": pad},
+                "feature_rng": "replicated-rng-stub",
+            },
+        }
+    return payloads, global_score, owner
+
+
+def test_elastic_local_state_reassembles_exact_scores():
+    payloads, global_score, owner = _fake_rank_payloads()
+    # shrink to 2 ranks: each new rank owns a fresh partition
+    new_owner = np.asarray([i % 2 for i in range(global_score.shape[1])])
+    for new_rank in (0, 1):
+        new_idx = np.nonzero(new_owner == new_rank)[0].astype(np.int64)
+        state = ckpt_mod.elastic_local_state(payloads, new_idx)
+        got = ckpt_mod.decode_array(state["score"])
+        np.testing.assert_array_equal(got, global_score[:, new_idx])
+        assert state["num_data"] == len(new_idx)
+    # ... and to a single process owning every row in order
+    state = ckpt_mod.elastic_local_state(
+        payloads, np.arange(global_score.shape[1], dtype=np.int64))
+    np.testing.assert_array_equal(
+        ckpt_mod.decode_array(state["score"]), global_score)
+
+
+def test_elastic_local_state_refuses_incomplete_world():
+    payloads, global_score, _ = _fake_rank_payloads()
+    del payloads[2]
+    with pytest.raises(ckpt_mod.CheckpointError, match="cover"):
+        ckpt_mod.elastic_local_state(
+            payloads, np.arange(global_score.shape[1], dtype=np.int64))
+
+
+def test_elastic_local_state_refuses_missing_row_index():
+    payloads, global_score, _ = _fake_rank_payloads(world=2)
+    del payloads[1]["state"]["row_index"]
+    with pytest.raises(ckpt_mod.CheckpointError, match="row indices"):
+        ckpt_mod.elastic_local_state(
+            payloads, np.arange(global_score.shape[1], dtype=np.int64))
+
+
+def test_load_world_iteration_requires_every_rank(tmp_path):
+    m0 = ckpt_mod.CheckpointManager(str(tmp_path), rank=0)
+    m1 = ckpt_mod.CheckpointManager(str(tmp_path), rank=1)
+    m0.save({"iteration": 3, "state": {}}, 3)
+    m1.save({"iteration": 3, "state": {}}, 3)
+    got = m0.load_world_iteration(3, expected_ranks=2)
+    assert sorted(got) == [0, 1]
+    with pytest.raises(ckpt_mod.CheckpointError, match=r"\[2\]"):
+        m0.load_world_iteration(3, expected_ranks=3)
+
+
+def test_latest_complete_iteration_skips_skewed_tail(tmp_path):
+    """A dying rank leaves the series skewed (rank 0 wrote iteration 4,
+    rank 1 only reached 3): the elastic fallback must land on the
+    newest iteration EVERY original rank can reassemble."""
+    m0 = ckpt_mod.CheckpointManager(str(tmp_path), rank=0)
+    m1 = ckpt_mod.CheckpointManager(str(tmp_path), rank=1)
+    for it in (3, 4):
+        m0.save({"iteration": it, "state": {}}, it)
+    m1.save({"iteration": 3, "state": {}}, 3)
+    it, payloads = m0.latest_complete_iteration(2)
+    assert it == 3 and sorted(payloads) == [0, 1]
+    assert payloads[1]["iteration"] == 3
+    assert m0.latest_complete_iteration(2, before=4)[0] == 3
+    assert m0.latest_complete_iteration(2, before=3) is None
+    assert m0.latest_complete_iteration(3) is None  # rank 2 never wrote
+    # a corrupt file at the common iteration falls back further
+    m0.save({"iteration": 2, "state": {}}, 2)
+    m1.save({"iteration": 2, "state": {}}, 2)
+    faults.corrupt_file(m1.path_for(3))
+    assert m0.latest_complete_iteration(2)[0] == 2
+    # ... and load_world_iteration SKIPS the corrupt file, raising
+    # only when completeness is demanded (naming it unreadable)
+    assert sorted(m0.load_world_iteration(3)) == [0]
+    with pytest.raises(ckpt_mod.CheckpointError, match="unreadable"):
+        m0.load_world_iteration(3, expected_ranks=2)
+
+
+def test_load_latest_any_rank_adopts_other_series(tmp_path):
+    m1 = ckpt_mod.CheckpointManager(str(tmp_path), rank=1)
+    m1.save({"iteration": 4, "state": {}}, 4)
+    m9 = ckpt_mod.CheckpointManager(str(tmp_path), rank=9)
+    assert m9.load_latest() is None
+    payload, path = m9.load_latest_any_rank()
+    assert payload["iteration"] == 4
+    assert path.endswith(".r1")
+
+
+# ---------------------------------------------------------------------------
+# fingerprint hygiene
+# ---------------------------------------------------------------------------
+def test_fingerprint_excludes_world_size_and_watchdog_params():
+    base = {"objective": "binary", "num_leaves": 31}
+    fp = ckpt_mod.config_fingerprint(base, 1000, 10, "gbdt")
+    changed = dict(base, num_machines=4, local_listen_port=9999,
+                   machine_list_filename="hosts.txt", time_out=5,
+                   tpu_collective_timeout_s=30.0,
+                   tpu_heartbeat_dir="/hb", tpu_heartbeat_lease_s=9.0,
+                   tpu_elastic_resume=False)
+    assert ckpt_mod.config_fingerprint(changed, 1000, 10, "gbdt") == fp
+    # trajectory-relevant params still fingerprint
+    assert ckpt_mod.config_fingerprint(
+        dict(base, num_leaves=15), 1000, 10, "gbdt") != fp

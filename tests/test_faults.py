@@ -75,3 +75,71 @@ def test_simulated_preemption_kills_training_mid_run():
         with pytest.raises(faults.SimulatedPreemption):
             lgb.train(params, lgb.Dataset(X, y), num_boost_round=20,
                       verbose_eval=False)
+
+
+# ---------------------------------------------------------------------------
+# distributed fault shapes (ISSUE 11): wedge / rank-targeted kill / env plan
+# ---------------------------------------------------------------------------
+def test_wedge_collective_blocks_once_then_passes():
+    import time
+    with faults.active() as plan:
+        faults.wedge_collective("some.site", 0.15)
+        t0 = time.time()
+        faults.inject("some.site")        # blocks ~0.15s (the wedge)
+        wedged = time.time() - t0
+        t0 = time.time()
+        faults.inject("some.site")        # one-shot: passes through
+        clean = time.time() - t0
+    assert wedged >= 0.14, wedged
+    assert clean < 0.05, clean
+    assert plan.fired == ["wedge@some.site"]
+
+
+def test_fail_next_collective_arms_dispatch_site():
+    with faults.active() as plan:
+        faults.fail_next_collective(2)
+        with pytest.raises(faults.InjectedFault):
+            faults.inject("collective.call")
+        with pytest.raises(faults.InjectedFault):
+            faults.inject("collective.call")
+        faults.inject("collective.call")  # exhausted
+    assert plan.fired == ["collective.call", "collective.call"]
+
+
+def test_kill_rank_fires_only_on_matching_rank(monkeypatch):
+    monkeypatch.setenv("LGBM_TPU_RANK", "2")
+    with faults.active(kill_rank=(1, 3)):
+        faults.inject("train.iteration", iteration=5)   # rank 2: survives
+    with faults.active(kill_rank=(2, 3)) as plan:
+        faults.inject("train.iteration", iteration=2)   # before k: survives
+        with pytest.raises(faults.SimulatedPreemption):
+            faults.inject("train.iteration", iteration=3)
+    assert plan.fired == ["kill_rank2@3"]
+
+
+def test_env_fault_plan_round_trip(monkeypatch):
+    """Child processes are armed through LGBM_TPU_FAULT_PLAN (the
+    elastic supervisor's injection channel) — parsed lazily on the
+    first inject call with no in-process plan."""
+    import json
+    monkeypatch.setenv(faults.FAULT_PLAN_ENV, json.dumps(
+        {"fail": {"x.site": 1}, "wedge": {"y.site": 0.01},
+         "kill_rank": [0, 7]}))
+    monkeypatch.setenv("LGBM_TPU_RANK", "0")
+    monkeypatch.setattr(faults, "_plan", None)
+    monkeypatch.setattr(faults, "_env_checked", False)
+    with pytest.raises(faults.InjectedFault):
+        faults.inject("x.site")
+    faults.inject("y.site")  # the wedge (short sleep)
+    with pytest.raises(faults.SimulatedPreemption):
+        faults.inject("train.iteration", iteration=7)
+    faults.reset()
+
+
+def test_env_fault_plan_unparseable_is_loud(monkeypatch):
+    monkeypatch.setenv(faults.FAULT_PLAN_ENV, "{not json")
+    monkeypatch.setattr(faults, "_plan", None)
+    monkeypatch.setattr(faults, "_env_checked", False)
+    with pytest.raises(ValueError):
+        faults.inject("any.site")
+    faults.reset()
