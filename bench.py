@@ -10,6 +10,11 @@ comm elements per device count — the MULTICHIP_*.json trajectory);
 BENCH_SHAPE=serve runs the serving-tier suite (quantized f32/f16/int8
 bulk throughput + open-loop sustained load with a mid-run hot swap +
 eviction probe, written to BENCH_SERVE_r07.json);
+BENCH_SHAPE=overload runs the serving overload-resilience gate
+(scripts/overload_smoke.py: open-loop 2x-saturation shedding with
+bounded admitted p99, circuit-breaker trip/recovery, single-flight
+compile storm, persistent-compile-cache cold start — commits
+OVERLOAD_r01.json).
 BENCH_SHAPE=elastic runs the kill->shrink->resume supervisor cycle
 (scripts/elastic_smoke.py: rank killed at W=4, wedged collective
 detected by the watchdog, elastic resume at W'=2 then W'=1,
@@ -888,34 +893,30 @@ def run_multichip() -> list:
     return out
 
 
-def run_elastic() -> dict:
-    """Elasticity gate (BENCH_SHAPE=elastic): run the supervisor's
-    kill -> detect -> shrink -> resume cycle headlessly and commit the
-    machine-readable artifact (ELASTIC_r01.json: ranks killed,
-    detection latency, resume outcome, byte-identity verdict). The
-    parent never touches a backend — every world size runs in its own
-    child (the multichip-gate discipline)."""
+def _run_smoke_gate(script_name: str, out_path: str, timeout_env: str,
+                    metric: str, extra_args=(), extra_env=None) -> dict:
+    """Shared child-gate runner for the smoke-script shapes (elastic,
+    overload): unlink the stale committed artifact (it must not
+    masquerade as this run's result when the smoke dies before
+    writing), run the script in a child with an env-tunable timeout,
+    and report the artifact (or the output tail on failure) as the
+    metric detail. The parent never touches a backend."""
     import subprocess
     import sys
 
-    out_path = os.environ.get(
-        "BENCH_ELASTIC_OUT",
-        os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                     "ELASTIC_r01.json"))
     script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                          "scripts", "elastic_smoke.py")
-    # a stale committed artifact must not masquerade as this run's
-    # result when the smoke dies before writing — remove it up front
+                          "scripts", script_name)
     try:
         os.unlink(out_path)
     except OSError:
         pass
-    cmd = [sys.executable, script, "--out", out_path,
-           "--mode", os.environ.get("BENCH_ELASTIC_MODE", "devices")]
+    env = dict(os.environ)
+    env.update(extra_env or {})
+    cmd = [sys.executable, script, "--out", out_path] + list(extra_args)
     try:
         res = subprocess.run(
-            cmd, capture_output=True, text=True,
-            timeout=float(os.environ.get("BENCH_ELASTIC_TIMEOUT", 900)))
+            cmd, env=env, capture_output=True, text=True,
+            timeout=float(os.environ.get(timeout_env, 900)))
         rc, tail = res.returncode, (res.stdout + res.stderr)[-800:]
     except subprocess.TimeoutExpired as exc:
         rc, tail = 124, "timeout: " + str(exc)
@@ -924,9 +925,41 @@ def run_elastic() -> dict:
             detail = json.load(fh)
     except (OSError, json.JSONDecodeError):
         detail = {"error": tail}
-    return {"metric": "elastic_kill_shrink_resume",
-            "value": 1.0 if rc == 0 else 0.0, "unit": "ok", "rc": rc,
-            "detail": detail}
+    return {"metric": metric, "value": 1.0 if rc == 0 else 0.0,
+            "unit": "ok", "rc": rc, "detail": detail}
+
+
+def run_elastic() -> dict:
+    """Elasticity gate (BENCH_SHAPE=elastic): run the supervisor's
+    kill -> detect -> shrink -> resume cycle headlessly and commit the
+    machine-readable artifact (ELASTIC_r01.json: ranks killed,
+    detection latency, resume outcome, byte-identity verdict). The
+    parent never touches a backend — every world size runs in its own
+    child (the multichip-gate discipline)."""
+    return _run_smoke_gate(
+        "elastic_smoke.py",
+        os.environ.get("BENCH_ELASTIC_OUT",
+                       os.path.join(REPO, "ELASTIC_r01.json")),
+        "BENCH_ELASTIC_TIMEOUT", "elastic_kill_shrink_resume",
+        extra_args=["--mode",
+                    os.environ.get("BENCH_ELASTIC_MODE", "devices")])
+
+
+def run_overload() -> dict:
+    """Overload-resilience gate (BENCH_SHAPE=overload): run the serving
+    tier's admission/shedding/breaker/cold-start smoke headlessly and
+    commit the machine-readable artifact (OVERLOAD_r01.json: open-loop
+    bench at ~2x saturation with bounded admitted p99 + structured
+    rejections, breaker trip/recovery, single-flight compile storm,
+    persistent-compile-cache cold start). BENCH_ALLOW_CPU=1 pins the
+    child to the CPU backend, the serve/elastic-gate discipline."""
+    return _run_smoke_gate(
+        "overload_smoke.py",
+        os.environ.get("BENCH_OVERLOAD_OUT",
+                       os.path.join(REPO, "OVERLOAD_r01.json")),
+        "BENCH_OVERLOAD_TIMEOUT", "overload_shed_breaker_coldstart",
+        extra_env={"JAX_PLATFORMS": "cpu"}
+        if os.environ.get("BENCH_ALLOW_CPU") == "1" else None)
 
 
 def main():
@@ -948,6 +981,11 @@ def main():
         return
     if which == "elastic":
         print(json.dumps(run_elastic()), flush=True)
+        return
+    if which == "overload":
+        # same parent-never-touches-a-backend discipline as elastic:
+        # the smoke runs in its own child process
+        print(json.dumps(run_overload()), flush=True)
         return
     _init_backend_with_retry()
     if which == "amortized":

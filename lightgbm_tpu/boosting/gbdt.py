@@ -297,6 +297,13 @@ class GBDT:
         # model-version bump, so a registry front end can track stack
         # budgets / swap visibility without polling
         self._version_listeners: List = []
+        # persistent XLA program cache (ISSUE 12): every program this
+        # booster traces — the grower passes AND the serving bucket
+        # ladder — persists to disk, so a restarted trainer or a cold
+        # serving replica warms from a file read instead of a re-trace
+        if getattr(config.io, "tpu_compile_cache_dir", ""):
+            from ..serving.forest import enable_compile_cache
+            enable_compile_cache(config.io.tpu_compile_cache_dir)
 
     # ------------------------------------------------------------------
     def init(self, train_data: Dataset, objective: Optional[ObjectiveFunction],

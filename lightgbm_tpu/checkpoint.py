@@ -75,6 +75,13 @@ _FINGERPRINT_EXCLUDE = {
     "time_out", "machine_list_filename",
     "tpu_collective_timeout_s", "tpu_heartbeat_dir",
     "tpu_heartbeat_lease_s", "tpu_elastic_resume",
+    # serving-side admission/overload knobs (ISSUE 12) shape request
+    # handling, never the training trajectory — and the compile cache
+    # only changes WHERE programs load from, not what they compute
+    "tpu_serving_max_queue", "tpu_serving_max_inflight",
+    "tpu_serving_deadline_ms", "tpu_serving_model_qps",
+    "tpu_serving_breaker_failures", "tpu_serving_breaker_reset_s",
+    "tpu_serving_budget_mb", "tpu_compile_cache_dir",
     "output_model", "output_result", "input_model", "convert_model",
     "config_file", "machine_list_file", "snapshot_freq", "verbose",
     "metric_freq", "num_iterations", "num_threads", "task",

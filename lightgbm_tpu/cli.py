@@ -323,6 +323,13 @@ def run_predict(params: Dict, cfg: Config) -> None:
         log.info("Serving with quantized forest layout '%s' (accuracy "
                  "gate tolerance %g)", cfg.io.tpu_predict_quantize,
                  cfg.io.tpu_predict_quantize_tol)
+    if cfg.io.tpu_serving_deadline_ms > 0 or cfg.io.tpu_serving_max_queue \
+            or cfg.io.tpu_serving_max_inflight:
+        log.info("Serving admission armed: deadline=%gms max_queue=%d "
+                 "max_inflight=%d (refusals raise structured retriable "
+                 "errors)", cfg.io.tpu_serving_deadline_ms,
+                 cfg.io.tpu_serving_max_queue,
+                 cfg.io.tpu_serving_max_inflight)
     result = predictor.predict(data)
     stats = predictor.stats()
     if stats.get("mean_latency_ms"):
@@ -331,6 +338,13 @@ def run_predict(params: Dict, cfg: Config) -> None:
                  "restack(s))", data.shape[0], secs,
                  data.shape[0] / max(secs, 1e-9),
                  stats.get("stack_restacks", 0))
+    adm = stats.get("admission", {})
+    if adm.get("rejected"):
+        log.warning("Admission rejected %d request(s) this run: %s",
+                    adm["rejected"],
+                    {k: v for k, v in adm.items()
+                     if k in ("shed", "deadline_expired", "queue_full",
+                              "inflight_full", "compile_wait") and v})
     result = np.atleast_1d(np.asarray(result))
     with open(cfg.io.output_result, "w") as fh:
         # vectorized formatting (np.char.mod runs the %-format in C): a

@@ -221,6 +221,37 @@ class IOConfig:
     # across all resident models, in MiB (0 = unlimited); the registry
     # LRU-evicts idle models' stacks past it
     tpu_serving_budget_mb: float = 0.0
+    # admission control (serving/admission.py; all 0 = off, the
+    # pre-admission unbounded behavior): max queued submit() requests
+    # per predictor — past it new requests are refused with a
+    # structured retriable ServingOverload instead of queueing late
+    tpu_serving_max_queue: int = 0
+    # max concurrent synchronous predict() calls per predictor
+    tpu_serving_max_inflight: int = 0
+    # default per-request deadline: a request whose estimated queue
+    # wait (EWMA) exceeds it is shed at admission, and one that expires
+    # while queued is failed with DeadlineExceeded before any device
+    # work; per-call deadline_ms= overrides this
+    tpu_serving_deadline_ms: float = 0.0
+    # per-model QPS isolation in serving.ModelRegistry: token-bucket
+    # rate per published model (tokens/s, burst = one second's worth;
+    # 0 = unlimited) — a hot model sheds with "rate_limited" instead of
+    # starving the other resident models
+    tpu_serving_model_qps: float = 0.0
+    # per-model circuit breaker: consecutive predict failures before
+    # the breaker opens (overload rejections never count); 0 = off,
+    # the default — like every other admission knob, pre-ISSUE-12
+    # behavior is exactly reproduced unless explicitly armed
+    tpu_serving_breaker_failures: int = 0
+    # seconds the breaker stays open before half-opening for a single
+    # probe; failed probes re-open with exponential backoff
+    tpu_serving_breaker_reset_s: float = 5.0
+    # persistent XLA compilation cache directory: the shape-bucket
+    # ladder's compiled programs are written here, so a restarted
+    # trainer or serving replica warms from disk instead of re-tracing
+    # (overrides the package-level LIGHTGBM_TPU_COMPILE_CACHE_DIR
+    # default; empty = leave the package default in place)
+    tpu_compile_cache_dir: str = ""
     # Predictor.warmup() compiles bucket programs up to this many rows
     tpu_predict_warmup_rows: int = 4096
     # Predictor.submit() coalesces up to this many concurrent single-row
@@ -537,6 +568,13 @@ class Config:
         if self.io.tpu_serving_budget_mb < 0:
             log.fatal("tpu_serving_budget_mb must be >= 0 (got %r)"
                       % (self.io.tpu_serving_budget_mb,))
+        for p in ("tpu_serving_max_queue", "tpu_serving_max_inflight",
+                  "tpu_serving_deadline_ms", "tpu_serving_model_qps",
+                  "tpu_serving_breaker_failures",
+                  "tpu_serving_breaker_reset_s"):
+            if getattr(self.io, p) < 0:
+                log.fatal("%s must be >= 0 (got %r)"
+                          % (p, getattr(self.io, p)))
         if self.tree.histogram_pool_size >= 0 and self.tree_learner != "serial":
             log.warning("histogram_pool_size is only supported by serial "
                         "tree learner; ignoring")

@@ -13,11 +13,27 @@ atomic zero-drop hot swap. The reference analogue is `Predictor`
 (predictor.hpp:24-205), whose prediction closures are likewise built
 once per booster, not per call; the registry/quantization tier follows
 the GBDT inference accelerator literature (arXiv:2011.02022).
+
+Overload resilience (admission.py, ISSUE 12): queue/in-flight caps,
+per-request deadlines and EWMA load shedding on the `Predictor`;
+per-model token-bucket QPS isolation and circuit breakers in the
+`ModelRegistry`; cold-start-storm protection (`SingleFlight` — one
+compile per unseen shape bucket) plus the persistent compile cache
+(`tpu_compile_cache_dir`) in forest.py. Refused requests always get a
+structured, retriable `ServingOverload` / `DeadlineExceeded`; admitted
+requests stay bit-identical to an unloaded serve.
 """
-from .forest import (QUANTIZE_MODES, CompiledForest, bucket_ladder,
-                     bucket_rows, pad_rows)
+from .admission import (AdmissionController, CircuitBreaker,
+                        DeadlineExceeded, PredictorShutdown,
+                        ServingOverload, TokenBucket)
+from .forest import (QUANTIZE_MODES, CompiledForest, SingleFlight,
+                     bucket_ladder, bucket_rows, enable_compile_cache,
+                     pad_rows)
 from .predictor import Predictor
 from .registry import ModelRegistry
 
-__all__ = ["CompiledForest", "ModelRegistry", "Predictor",
-           "QUANTIZE_MODES", "bucket_ladder", "bucket_rows", "pad_rows"]
+__all__ = ["AdmissionController", "CircuitBreaker", "CompiledForest",
+           "DeadlineExceeded", "ModelRegistry", "Predictor",
+           "PredictorShutdown", "QUANTIZE_MODES", "ServingOverload",
+           "SingleFlight", "TokenBucket", "bucket_ladder", "bucket_rows",
+           "enable_compile_cache", "pad_rows"]

@@ -39,7 +39,9 @@ after the fetch.
 """
 from __future__ import annotations
 
+import os
 import threading
+import time
 from collections import OrderedDict
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -285,6 +287,143 @@ class CompiledForest:
                 lambda a: jnp.swapaxes(
                     a.reshape((t_iters, k) + a.shape[1:]), 0, 1), stacked)
         return self._get(("early_stop", t_iters, k), build)
+
+
+class SingleFlightExpired(Exception):
+    """A follower's bounded wait for the leader's build ran out (the
+    caller converts this into its deadline/shed rejection)."""
+
+
+class SingleFlight:
+    """Cold-start-storm protection: N concurrent first requests on an
+    unseen key (a shape bucket about to pay its first trace) run
+    exactly ONE build — the leader proceeds and everyone else waits for
+    its program, bounded by their own deadlines.
+
+    Without this, a freshly restarted replica taking a traffic burst
+    compiles the same 29-81s wide-shape program once PER CONCURRENT
+    REQUEST (jit caches the result, but the storm of identical traces
+    races in before the first one lands). `begin(key)` returns True for
+    exactly one caller per unseen key; followers block until the leader
+    `finish()`es (success marks the key done forever) or their timeout
+    expires (`SingleFlightExpired` — shed under the deadline instead of
+    queueing on a compile). A FAILED leader wakes the followers and the
+    next one through becomes the new leader, so one poisoned build
+    cannot wedge the key."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._done: set = set()
+        self._leading: Dict[Any, threading.Event] = {}
+        self.counts: Dict[str, int] = {"leads": 0, "waits": 0,
+                                       "expired": 0}
+
+    def seen(self, key) -> bool:
+        with self._lock:
+            return key in self._done
+
+    def mark(self, key) -> None:
+        """Record a key as already-built (warmup marks its whole
+        ladder so warmed traffic never enters the flight path)."""
+        with self._lock:
+            self._done.add(key)
+
+    def begin(self, key, timeout: Optional[float] = None) -> bool:
+        """True = caller is the leader and MUST call finish(). False =
+        a leader already built the key (possibly after a wait)."""
+        from .. import tracing
+        deadline = None if timeout is None \
+            else time.monotonic() + max(0.0, timeout)
+        while True:
+            with self._lock:
+                if key in self._done:
+                    return False
+                ev = self._leading.get(key)
+                if ev is None:
+                    self._leading[key] = threading.Event()
+                    self.counts["leads"] += 1
+                    tracing.counter("serving/single_flight_leads", 1)
+                    return True
+                self.counts["waits"] += 1
+            tracing.counter("serving/single_flight_waits", 1)
+            remaining = None if deadline is None \
+                else deadline - time.monotonic()
+            if remaining is not None and remaining <= 0:
+                with self._lock:
+                    self.counts["expired"] += 1
+                tracing.counter("serving/single_flight_expired", 1)
+                raise SingleFlightExpired(key)
+            if not ev.wait(timeout=remaining):
+                with self._lock:
+                    self.counts["expired"] += 1
+                tracing.counter("serving/single_flight_expired", 1)
+                raise SingleFlightExpired(key)
+            # woken: either the leader succeeded (key in done -> return
+            # False) or it failed (loop; first caller back in becomes
+            # the new leader)
+
+    def finish(self, key, ok: bool) -> None:
+        with self._lock:
+            if ok:
+                self._done.add(key)
+            ev = self._leading.pop(key, None)
+        if ev is not None:
+            ev.set()
+
+
+_COMPILE_CACHE_ARMED: Optional[str] = None
+_COMPILE_CACHE_LOCK = threading.Lock()
+
+
+def enable_compile_cache(path: str) -> bool:
+    """Point JAX's persistent compilation cache at `path`
+    (`tpu_compile_cache_dir`): every program the shape-bucket ladder
+    compiles is written to disk, and a RESTARTED replica's warmup()
+    loads the same ladder back instead of re-tracing it — the
+    29-81s wide-shape cold start becomes a file read. Thresholds are
+    dropped to zero so even small bucket programs persist (the default
+    1s floor would skip exactly the small-batch programs a serving
+    replica warms first). Idempotent per path; returns False when the
+    cache could not be armed (best-effort, serving proceeds without
+    it)."""
+    global _COMPILE_CACHE_ARMED
+    path = os.path.abspath(path)
+    with _COMPILE_CACHE_LOCK:
+        if _COMPILE_CACHE_ARMED == path:
+            return True
+        if _COMPILE_CACHE_ARMED is not None:
+            # the cache is PROCESS-GLOBAL (one jax config): two
+            # resident models naming different dirs cannot each get
+            # their own — the flip is honored but loudly, because the
+            # earlier model's future compiles now persist to the new
+            # path and its restarted replicas will find a cold cache
+            from .. import log
+            log.warning(
+                "tpu_compile_cache_dir is process-global: re-pointing "
+                "the persistent compile cache from %s to %s (programs "
+                "compiled from now on land in the new dir)",
+                _COMPILE_CACHE_ARMED, path)
+        try:
+            import jax
+            jax.config.update("jax_compilation_cache_dir", path)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              0.0)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                              -1)
+            try:
+                # a cache already initialized at another dir (the
+                # package-level default) must be re-pointed, not ignored
+                from jax._src import compilation_cache as _cc
+                _cc.reset_cache()
+            except Exception:  # pragma: no cover — jax-version-specific
+                pass
+        except Exception as exc:  # pragma: no cover — cache best-effort
+            from .. import log
+            log.warning("tpu_compile_cache_dir=%s could not be armed: %s",
+                        path, exc)
+            return False
+        _COMPILE_CACHE_ARMED = path
+    return True
 
 
 def _stacks_to_f16(mf, st):

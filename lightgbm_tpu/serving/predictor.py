@@ -1,5 +1,5 @@
 """Serving front end: warmup, low-latency small-batch path, optional
-micro-batching, and throughput/latency counters.
+micro-batching, admission control, and throughput/latency counters.
 
 The reference serves predictions through a per-model `Predictor`
 (predictor.hpp:24-205) whose closures are built once and reused per
@@ -11,26 +11,44 @@ layer adds what a serving process needs around it:
 
 - `warmup()` compiles the whole bucket ladder up front so the first
   real request never pays a trace (and the stacking happens exactly
-  once, before traffic arrives);
+  once, before traffic arrives); with `tpu_compile_cache_dir` set the
+  ladder's programs persist to disk, so a RESTARTED replica's warmup
+  loads them back instead of re-tracing;
 - `predict()` / `predict_one()` time every request into a latency ring
   and tracing counters (`serving/requests`, `serving/rows`), the same
   surface as the training-side counters;
 - `submit()` optionally coalesces concurrent single-row requests into
   one device dispatch (micro-batching): rows arriving within
   `tpu_predict_micro_batch_window_ms` of each other ride one bucketed
-  program instead of one dispatch each.
+  program instead of one dispatch each;
+- admission control (serving/admission.py): queue-depth / in-flight
+  caps (`tpu_serving_max_queue` / `tpu_serving_max_inflight`),
+  per-request deadlines (`tpu_serving_deadline_ms` + per-call
+  `deadline_ms=` overrides), and the EWMA shed policy — past
+  saturation, requests that would expire in the queue are refused
+  IMMEDIATELY with a structured retriable `ServingOverload` /
+  `DeadlineExceeded` instead of being answered late. Shedding changes
+  *whether* a request is answered, never *what* is answered: admitted
+  requests stay bit-identical to an unloaded serve;
+- cold-start-storm protection: concurrent first requests on an unseen
+  shape bucket run exactly one compile (`serving.forest.SingleFlight`);
+  the others wait under their deadlines or shed.
 """
 from __future__ import annotations
 
 import threading
 import time
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
 from typing import Any, Dict, List, Optional
 
 import numpy as np
 
 from .. import log, telemetry, tracing
-from .forest import bucket_ladder
+from ..testing import faults
+from .admission import (AdmissionController, DeadlineExceeded,
+                        PredictorShutdown, ServingOverload)
+from .forest import (SingleFlight, SingleFlightExpired, bucket_ladder,
+                     bucket_rows, enable_compile_cache)
 
 # latency histogram bounds: 10us..~20s exponential — a fixed-memory
 # distribution replacing the old bounded ring, so p50/p95/p99 cover the
@@ -38,6 +56,32 @@ from .forest import bucket_ladder
 _LATENCY_BOUNDS = tuple(1e-5 * (2.0 ** i) for i in range(22))
 # micro-batch size distribution (rows per coalesced dispatch)
 _BATCH_BOUNDS = (1, 2, 4, 8, 16, 32, 64, 128, 256)
+
+
+class _QueueItem:
+    """One queued submit(): the row, its future, and the admission
+    evidence the batch loop needs to expire/time it."""
+    __slots__ = ("arr", "fut", "enqueued", "deadline_abs")
+
+    def __init__(self, arr, fut, enqueued, deadline_abs):
+        self.arr = arr
+        self.fut = fut
+        self.enqueued = enqueued
+        self.deadline_abs = deadline_abs
+
+
+def _resolve(fut: Future, value) -> None:
+    try:
+        fut.set_result(value)
+    except InvalidStateError:  # raced close()'s shutdown sweep
+        pass
+
+
+def _fail(fut: Future, exc: BaseException) -> None:
+    try:
+        fut.set_exception(exc)
+    except InvalidStateError:
+        pass
 
 
 class Predictor:
@@ -61,11 +105,22 @@ class Predictor:
         self._micro_batch = max(0, int(io.tpu_predict_micro_batch))
         self._window_s = max(0.0, float(
             io.tpu_predict_micro_batch_window_ms)) / 1e3
+        self._bucket_min = int(io.tpu_predict_bucket_min)
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
-        self._queue: List = []
+        self._queue: List[_QueueItem] = []
         self._batcher: Optional[threading.Thread] = None
         self._closed = False
+        # admission control: all caps default to 0 (= off), reproducing
+        # the pre-admission unbounded behavior exactly
+        self.admission = AdmissionController(
+            max_queue=int(io.tpu_serving_max_queue),
+            max_inflight=int(io.tpu_serving_max_inflight),
+            deadline_s=max(0.0, float(io.tpu_serving_deadline_ms)) / 1e3)
+        # cold-start-storm protection: one compile per unseen bucket
+        self._single_flight = SingleFlight()
+        if getattr(io, "tpu_compile_cache_dir", ""):
+            enable_compile_cache(io.tpu_compile_cache_dir)
         # always-on local instruments (stats() must work with global
         # telemetry off), registered as SHARED registry instruments so
         # the Prometheus export reads the same series — one observe per
@@ -79,7 +134,8 @@ class Predictor:
             telemetry.Histogram("serving/micro_batch_rows",
                                 bounds=_BATCH_BOUNDS))
         self._counts = {"requests": 0, "rows": 0,
-                        "micro_batches": 0, "micro_rows": 0}
+                        "micro_batches": 0, "micro_rows": 0,
+                        "batch_isolated_rows": 0}
         self._warmup_seconds: Optional[float] = None
         self._warmup_buckets: List[int] = []
 
@@ -104,7 +160,9 @@ class Predictor:
         """Compile every bucket program up to `max_rows` (default
         `tpu_predict_warmup_rows`) and stack the forest once, so the
         first real request is pure device compute. Warmup traffic is
-        NOT counted in the request/latency stats."""
+        NOT counted in the request/latency stats. With
+        `tpu_compile_cache_dir` set the compiled programs also persist
+        to disk, so the next replica's warmup is a cache read."""
         io = self._gbdt.config.io
         cap = int(max_rows if max_rows is not None
                   else io.tpu_predict_warmup_rows)
@@ -119,7 +177,9 @@ class Predictor:
         self._gbdt._quant_gate_defer = True
         try:
             for rows in ladder:
-                self._predict_inner(np.zeros((rows, f), np.float32))
+                self._predict_timed(np.zeros((rows, f), np.float32),
+                                    count=False)
+                self._single_flight.mark(rows)
         finally:
             self._gbdt._quant_gate_defer = False
         self._warmup_seconds = time.perf_counter() - t0
@@ -130,70 +190,151 @@ class Predictor:
         return {"buckets": ladder, "seconds": self._warmup_seconds}
 
     # ------------------------------------------------------------------
-    def _predict_inner(self, arr: np.ndarray, **overrides):
+    def _request_bucket(self, nrows: int) -> Optional[int]:
+        """The shape bucket a request of `nrows` rows dispatches
+        through (the single-flight key). None when bucketing is off —
+        every size then traces its own program and there is no shared
+        bucket for a storm to pile onto. The row count is capped at the
+        dispatch chunk EXACTLY like GBDT._pipelined_chunks caps it:
+        two over-chunk requests of different sizes compile the same
+        chunk-bucket program and must share one flight key (the walk
+        default is used — for matmul layouts whose chunk is larger,
+        over-chunk requests merely share a key early, which only
+        widens the guard, never splits it)."""
+        if self._bucket_min <= 0 or nrows <= 0:
+            return None
+        cap = self._gbdt._predict_chunk_rows(
+            self._gbdt._PREDICT_ROW_CHUNK)
+        return bucket_rows(min(nrows, cap), self._bucket_min, cap=cap)
+
+    def _predict_timed(self, arr: np.ndarray, count: bool = True,
+                       deadline_abs: Optional[float] = None, **overrides):
+        """The timed dispatch body shared by predict(), the micro-batch
+        loop, and warmup(). Admission decisions happen in the PUBLIC
+        entry points — this layer only guards the cold-bucket compile
+        (single flight) and feeds the latency instruments."""
         kw = dict(self._kwargs)
         kw.update(overrides)
-        return self._gbdt.predict(arr, **kw)
+        t0 = time.perf_counter()
+        bucket = self._request_bucket(arr.shape[0])
+        lead = False
+        cold = bucket is not None and not self._single_flight.seen(bucket)
+        if cold:
+            timeout = None if deadline_abs is None \
+                else deadline_abs - time.perf_counter()
+            try:
+                lead = self._single_flight.begin(bucket, timeout=timeout)
+            except SingleFlightExpired:
+                raise self.admission._reject("compile_wait", ServingOverload(
+                    "Deadline expired while waiting for bucket %d's "
+                    "first compile (single-flight); retriable" % bucket,
+                    reason="compile_wait"))
+        ok = False
+        try:
+            if lead:
+                # test seam: compile_storm() wedges the leader here,
+                # simulating the 29-81s trace the followers must NOT
+                # replicate
+                faults.inject("serving.compile")
+            faults.inject("serving.predict")
+            out = self._gbdt.predict(arr, **kw)
+            ok = True
+        finally:
+            if lead:
+                self._single_flight.finish(bucket, ok)
+        dt = time.perf_counter() - t0
+        if count and not cold:
+            # compile time is NOT service-time evidence: a cold-bucket
+            # request (the single-flight leader pays the trace, its
+            # followers pay the wait) or a slow warmup would otherwise
+            # prime the EWMA at compile scale — ~30s on wide shapes —
+            # and the shed policy would then refuse every deadline-
+            # bearing request forever (shed requests never dispatch, so
+            # nothing would ever correct the estimate)
+            self.admission.observe_service(dt)
+        if count:
+            with self._lock:
+                self._counts["requests"] += 1
+                self._counts["rows"] += int(arr.shape[0])
+            self._latency_hist.observe(dt)
+            tracing.counter("serving/requests", 1)
+            tracing.counter("serving/rows", int(arr.shape[0]))
+        return out
 
-    def predict(self, data, **overrides):
+    def predict(self, data, deadline_ms: Optional[float] = None,
+                **overrides):
         """Timed predict over a [N, F] batch (rows also accepted as a
         single 1-D row, returned as a 1-row result — use predict_one()
-        for the squeezed scalar path)."""
-        kw = dict(self._kwargs)
-        kw.update(overrides)
+        for the squeezed scalar path). `deadline_ms` overrides
+        `tpu_serving_deadline_ms` for this call: a request whose
+        estimated service time already exceeds it is refused with a
+        structured retriable error BEFORE any device work."""
         # TreeSHAP walks raw f64 thresholds (shap._decision_vec): an f32
         # cast here can flip a hot/cold path for values straddling an
         # f32-rounded threshold, so contrib keeps the caller's dtype
-        arr = np.asarray(data) if kw.get("pred_contrib") \
+        # (_predict_timed does the full kwargs merge for the dispatch)
+        contrib = overrides.get("pred_contrib",
+                                self._kwargs["pred_contrib"])
+        arr = np.asarray(data) if contrib \
             else np.asarray(data, np.float32)
         if arr.ndim == 1:
             arr = arr.reshape(1, -1)
         self._check_width(arr)
-        t0 = time.perf_counter()
-        out = self._gbdt.predict(arr, **kw)
-        dt = time.perf_counter() - t0
-        with self._lock:
-            self._counts["requests"] += 1
-            self._counts["rows"] += int(arr.shape[0])
-        self._latency_hist.observe(dt)
-        tracing.counter("serving/requests", 1)
-        tracing.counter("serving/rows", int(arr.shape[0]))
-        return out
+        deadline_abs = self.admission.deadline_for(deadline_ms)
+        self.admission.admit_sync(deadline_abs)
+        try:
+            return self._predict_timed(arr, deadline_abs=deadline_abs,
+                                       **overrides)
+        finally:
+            self.admission.release_sync()
 
-    def predict_one(self, row, **overrides):
+    def predict_one(self, row, deadline_ms: Optional[float] = None,
+                    **overrides):
         """Single-row fast path: pads to the smallest bucket on one
         resident compiled program; returns the row's prediction with
         the batch axis squeezed."""
         return self.predict(np.asarray(row, np.float32).reshape(1, -1),
-                            **overrides)[0]
+                            deadline_ms=deadline_ms, **overrides)[0]
 
     # ------------------------------------------------------------------
     # micro-batching: coalesce concurrent single-row requests
-    def submit(self, row) -> Future:
+    def submit(self, row, deadline_ms: Optional[float] = None) -> Future:
         """Enqueue one row; resolves to its prediction. With
         `tpu_predict_micro_batch` 0 this degenerates to a synchronous
         predict_one; otherwise rows arriving within the window share
-        one device dispatch."""
+        one device dispatch. Refusals (queue full, shed, closed) raise
+        `ServingOverload` HERE — an accepted Future either resolves to
+        a prediction or fails with a structured error (deadline expiry,
+        shutdown, a predict failure); it is never silently dropped."""
         arr = np.asarray(row, np.float32).reshape(-1)
         # validate BEFORE enqueueing: a wrong-width row must fail its
         # caller, not poison the whole coalesced batch it would ride in
         self._check_width(arr.reshape(1, -1))
+        deadline_abs = self.admission.deadline_for(deadline_ms)
         fut: Future = Future()
         if self._micro_batch <= 0:
+            self.admission.admit_sync(deadline_abs)
             try:
-                fut.set_result(self.predict_one(arr))
+                fut.set_result(self._predict_timed(
+                    arr.reshape(1, -1), deadline_abs=deadline_abs)[0])
             except Exception as exc:  # surface through the future
                 fut.set_exception(exc)
+            finally:
+                self.admission.release_sync()
             return fut
         with self._cv:
             if self._closed:
-                raise log.LightGBMError("Predictor is closed")
+                raise PredictorShutdown()
+            # queue cap + EWMA shed under the lock: the depth the
+            # decision reads is the depth the enqueue appends to
+            self.admission.admit_queued(len(self._queue), deadline_abs)
             if self._batcher is None:
                 self._batcher = threading.Thread(
                     target=self._batch_loop, name="lgbm-tpu-microbatch",
                     daemon=True)
                 self._batcher.start()
-            self._queue.append((arr, fut))
+            self._queue.append(_QueueItem(arr, fut, time.perf_counter(),
+                                          deadline_abs))
             telemetry.gauge_set("serving/queue_depth", len(self._queue))
             self._cv.notify()
         return fut
@@ -215,46 +356,115 @@ class Predictor:
                 batch = self._queue[:self._micro_batch]
                 del self._queue[:len(batch)]
                 telemetry.gauge_set("serving/queue_depth", len(self._queue))
-            # claim each future; a client may have cancel()ed while its
-            # row sat in the window (request-timeout pattern) — resolving
-            # a cancelled future raises and would kill this thread
-            live = [(r, f) for r, f in batch
-                    if f.set_running_or_notify_cancel()]
+            now = time.perf_counter()
+            live = []
+            for item in batch:
+                self.admission.observe_wait(now - item.enqueued)
+                # claim each future; a client may have cancel()ed while
+                # its row sat in the window (request-timeout pattern) —
+                # resolving a cancelled future raises and would kill
+                # this thread
+                if not item.fut.set_running_or_notify_cancel():
+                    continue
+                if item.deadline_abs is not None and now > item.deadline_abs:
+                    # expired in the queue: prompt structured rejection
+                    # BEFORE burning device time on a row whose answer
+                    # nobody is waiting for anymore
+                    _fail(item.fut, self.admission.expire(
+                        now - item.enqueued, item.deadline_abs))
+                    continue
+                live.append(item)
             if not live:
                 continue
-            rows = np.stack([r for r, _ in live])
+            rows = np.stack([item.arr for item in live])
+            # the batch inherits its TIGHTEST member deadline so a
+            # cold-bucket compile (single-flight wait) cannot answer
+            # deadline-bearing futures tens of seconds late; if the
+            # dispatch sheds on it, the per-row isolation pass below
+            # re-runs each row under its OWN deadline (a no-deadline
+            # row then waits the compile out instead of failing)
+            deadlines = [item.deadline_abs for item in live
+                         if item.deadline_abs is not None]
             try:
-                res = self.predict(rows)
+                res = self._predict_timed(
+                    rows, deadline_abs=min(deadlines) if deadlines
+                    else None)
             except Exception as exc:
-                for _, fut in live:
-                    fut.set_exception(exc)
+                self._isolate_batch_failure(live, exc)
                 continue
             with self._lock:
                 self._counts["micro_batches"] += 1
                 self._counts["micro_rows"] += len(live)
             self._batch_hist.observe(len(live))
             tracing.counter("serving/micro_batches", 1)
-            for i, (_, fut) in enumerate(live):
-                fut.set_result(res[i])
+            for i, item in enumerate(live):
+                _resolve(item.fut, res[i])
 
-    def close(self) -> None:
-        """Stop the micro-batcher (pending requests still complete)."""
+    def _isolate_batch_failure(self, live: List[_QueueItem],
+                               exc: BaseException) -> None:
+        """A predict failure inside a coalesced batch must fail only
+        the rows that actually fail: re-run each row alone so one
+        poisoned row (or one transient fault) cannot take down every
+        co-riding future. Single-row batches skip the retry — the
+        failure IS that row's answer. Each re-run honors its row's
+        deadline: under overload the serialized per-row dispatches can
+        outlive deadlines that were met at pop time, and an expired
+        row must not burn device time nobody is waiting for."""
+        if len(live) == 1:
+            _fail(live[0].fut, exc)
+            return
+        tracing.counter("serving/batch_isolated", 1)
+        with self._lock:
+            self._counts["batch_isolated_rows"] += len(live)
+        for item in live:
+            now = time.perf_counter()
+            if item.deadline_abs is not None and now > item.deadline_abs:
+                _fail(item.fut, self.admission.expire(
+                    now - item.enqueued, item.deadline_abs))
+                continue
+            try:
+                out = self._predict_timed(item.arr.reshape(1, -1),
+                                          count=False,
+                                          deadline_abs=item.deadline_abs)
+            except Exception as row_exc:
+                _fail(item.fut, row_exc)
+            else:
+                _resolve(item.fut, out[0])
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Stop the micro-batcher. Queued requests are drained (they
+        complete on this model — the registry's hot-swap contract);
+        anything the batcher fails to drain within `timeout` (a wedged
+        device, a dead thread) is failed with a structured
+        `PredictorShutdown` instead of leaking an unresolved Future."""
         with self._cv:
             self._closed = True
             self._cv.notify_all()
         if self._batcher is not None:
-            self._batcher.join(timeout=5.0)
+            self._batcher.join(timeout=timeout)
             self._batcher = None
+        # shutdown sweep: after the drain window nothing may stay
+        # pending forever — a leaked Future is an indefinitely blocked
+        # caller, the one outcome the overload contract forbids
+        with self._cv:
+            leftovers = self._queue[:]
+            del self._queue[:]
+            telemetry.gauge_set("serving/queue_depth", 0)
+        for item in leftovers:
+            if item.fut.set_running_or_notify_cancel():
+                _fail(item.fut, PredictorShutdown())
+                tracing.counter("serving/shutdown_failed_futures", 1)
 
     # ------------------------------------------------------------------
     def stats(self) -> Dict[str, Any]:
         """Counters in the same spirit as tracing's training counters:
         request/row totals, service-lifetime latency percentiles (from
         the bucketed telemetry histogram — bucket-resolution estimates,
-        not a bounded recent-window sort), throughput, and the forest
-        cache's restack economics. The aggregates are also mirrored into
-        `serving/*` registry gauges so the Prometheus export carries
-        them without a stats() caller in the loop."""
+        not a bounded recent-window sort), throughput, admission /
+        shed / single-flight counters, and the forest cache's restack
+        economics. The aggregates are also mirrored into `serving/*`
+        registry gauges so the Prometheus export carries them without a
+        stats() caller in the loop."""
         with self._lock:
             counts = dict(self._counts)
         hist = self._latency_hist.snapshot()
@@ -265,6 +475,8 @@ class Predictor:
         out["quantize"] = str(self._gbdt.config.io.tpu_predict_quantize)
         out["warmup_seconds"] = self._warmup_seconds
         out["warmup_buckets"] = list(self._warmup_buckets)
+        out["admission"] = self.admission.stats()
+        out["single_flight"] = dict(self._single_flight.counts)
         if hist["count"]:
             out["p50_latency_ms"] = round(
                 self._latency_hist.quantile(0.50) * 1e3, 4)

@@ -25,6 +25,17 @@ exactly this shape around it.
   accepted under; requests racing the swap retry onto the new entry —
   zero dropped, zero misrouted (gated by
   scripts/predict_latency_smoke.py and the sustained-load bench).
+- **Per-model QPS isolation + circuit breaking** (ISSUE 12,
+  serving/admission.py): each published model gets its own token
+  bucket (`tpu_serving_model_qps`) — a hot model drains its OWN budget
+  and sheds with a structured retriable "rate_limited" error instead
+  of queueing into the shared device and starving the other residents
+  — and its own circuit breaker: repeated predict failures trip it
+  open (requests refused without touching the model), and it
+  half-opens after a backoff for a single probe. Overload rejections
+  (shed/deadline/queue-full) never count as breaker failures: shedding
+  says nothing about the model's health — crucially, a swap-in model
+  arriving while the tier is shedding starts with a clean breaker.
 - **Telemetry**: resident-model count, stack bytes vs budget, eviction
   and publish counts, and per-model request counters are mirrored into
   `serving/registry_*` gauges on the hot paths themselves, so the
@@ -36,17 +47,22 @@ from __future__ import annotations
 import threading
 import time
 from collections import OrderedDict
+from concurrent.futures import CancelledError as FutureCancelledError
+from concurrent.futures import Future
 from typing import Any, Dict, Optional
 
 from .. import log, telemetry
+from .admission import CircuitBreaker, ServingOverload, TokenBucket
 from .predictor import Predictor
 
 
 class _Entry:
     __slots__ = ("name", "booster", "gbdt", "predictor", "publish_version",
-                 "requests", "published_at", "listener")
+                 "requests", "published_at", "listener", "bucket",
+                 "breaker")
 
-    def __init__(self, name, booster, gbdt, predictor, publish_version):
+    def __init__(self, name, booster, gbdt, predictor, publish_version,
+                 bucket=None, breaker=None):
         self.name = name
         self.booster = booster
         self.gbdt = gbdt
@@ -55,6 +71,11 @@ class _Entry:
         self.requests = 0
         self.published_at = time.time()
         self.listener = None
+        # per-model QPS token bucket (None = unlimited) + circuit
+        # breaker: fresh per publish — a swap-in model never inherits
+        # the outgoing version's failure history
+        self.bucket = bucket
+        self.breaker = breaker
 
 
 class ModelRegistry:
@@ -69,18 +90,27 @@ class ModelRegistry:
 
     def __init__(self, budget_mb: Optional[float] = None,
                  warmup_rows: Optional[int] = None,
+                 model_qps: Optional[float] = None,
+                 breaker_failures: Optional[int] = None,
+                 breaker_reset_s: Optional[float] = None,
                  **predictor_kwargs):
         self._lock = threading.RLock()
         self._models: "OrderedDict[str, _Entry]" = OrderedDict()
         self._budget_mb = budget_mb
         self._warmup_rows = warmup_rows
+        # None = read each model's config at publish time (the params
+        # path); explicit ctor values override for embedding callers
+        self._model_qps = model_qps
+        self._breaker_failures = breaker_failures
+        self._breaker_reset_s = breaker_reset_s
         self._predictor_kwargs = dict(predictor_kwargs)
         self._closed = False
         # budget recomputed on publish/unpublish, read per request: the
         # no-budget default must cost nothing on the submit hot path
         self._budget_cached = 0
         self.stats_counts: Dict[str, int] = {
-            "publishes": 0, "swaps": 0, "evictions": 0, "requests": 0}
+            "publishes": 0, "swaps": 0, "evictions": 0, "requests": 0,
+            "rate_limited": 0, "breaker_rejected": 0}
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -135,7 +165,20 @@ class ModelRegistry:
                 raise log.LightGBMError("ModelRegistry is closed")
             prev = self._models.pop(name, None)
             version = (prev.publish_version + 1) if prev else 1
-            entry = _Entry(name, booster, gbdt, predictor, version)
+            io = gbdt.config.io
+            qps = self._model_qps if self._model_qps is not None \
+                else float(getattr(io, "tpu_serving_model_qps", 0.0))
+            fails = self._breaker_failures \
+                if self._breaker_failures is not None \
+                else int(getattr(io, "tpu_serving_breaker_failures", 0))
+            reset = self._breaker_reset_s \
+                if self._breaker_reset_s is not None \
+                else float(getattr(io, "tpu_serving_breaker_reset_s", 5.0))
+            entry = _Entry(
+                name, booster, gbdt, predictor, version,
+                bucket=TokenBucket(qps) if qps > 0 else None,
+                breaker=CircuitBreaker(fails, reset) if fails > 0
+                else None)
             entry.listener = _on_version
             # listener registered BEFORE the entry becomes visible: a
             # racing publish/unpublish of the same name can then always
@@ -202,30 +245,122 @@ class ModelRegistry:
     # of surfacing the internal state ("zero dropped or misrouted").
     _SWAP_RETRIES = 3
 
-    def _with_predictor(self, name, fn):
+    def _admit_entry(self, entry: _Entry) -> None:
+        """Per-model isolation gates: token bucket, then breaker. Both
+        raise structured retriable errors — the caller gets a truthful
+        "this model, right now" signal, and the other residents keep
+        their full budget."""
+        if entry.bucket is not None and not entry.bucket.take():
+            with self._lock:
+                self.stats_counts["rate_limited"] += 1
+            telemetry.counter_add("serving/rate_limited", 1,
+                                  labels={"model": entry.name})
+            raise ServingOverload(
+                "Model %r is over its QPS budget (%.1f/s); retriable"
+                % (entry.name, entry.bucket.rate), reason="rate_limited",
+                retry_after_s=entry.bucket.retry_after_s(),
+                model=entry.name)
+        if entry.breaker is not None and not entry.breaker.allow():
+            with self._lock:
+                self.stats_counts["breaker_rejected"] += 1
+            telemetry.counter_add("serving/breaker_rejected", 1,
+                                  labels={"model": entry.name})
+            raise ServingOverload(
+                "Model %r circuit breaker is %s after repeated predict "
+                "failures; retriable" % (entry.name,
+                                         entry.breaker.state()),
+                reason="breaker_open",
+                retry_after_s=entry.breaker.retry_after_s(),
+                model=entry.name)
+
+    @staticmethod
+    def _record_outcome(entry: _Entry, exc: Optional[BaseException]) -> None:
+        """Feed the model's breaker. Three outcomes:
+
+        - success -> record_success (closes a half-open breaker);
+        - server-side predict failure (device error, injected fault) ->
+          record_failure — the only breaker evidence;
+        - NO evidence: overload rejections (shedding says nothing about
+          model health, so shed traffic during a hot swap cannot trip
+          the incoming model's breaker), client/config errors
+          (LightGBMError: wrong-width rows, bad overrides — the
+          CALLER's fault), and cancelled futures (the model was never
+          exercised) -> release a half-open probe slot so the next
+          request can probe, but never move the state.
+
+        The breaker-state gauge is refreshed on EVERY outcome — a
+        recovery must flip the exported series back to closed, not
+        leave the dashboard showing a breaker that no longer exists."""
+        if entry.breaker is None:
+            return
+        if exc is None:
+            entry.breaker.record_success()
+        elif isinstance(exc, (log.LightGBMError, FutureCancelledError)):
+            entry.breaker.release_probe()
+        else:
+            entry.breaker.record_failure()
+        telemetry.gauge_set("serving/breaker_state",
+                            {"closed": 0, "half_open": 1,
+                             "open": 2}[entry.breaker.state()],
+                            labels={"model": entry.name})
+
+    def _with_predictor(self, name, fn, sync: bool = True):
         last = None
         for _ in range(self._SWAP_RETRIES):
             entry = self._entry(name)
+            self._admit_entry(entry)
             try:
                 result = fn(entry.predictor)
-                self._enforce_budget(exclude=name)
-                return result
+            except ServingOverload as exc:
+                # no breaker evidence either way, but a half-open probe
+                # slot must be released or the breaker wedges probing
+                self._record_outcome(entry, exc)
+                if exc.reason != "shutdown":
+                    raise          # structured rejection: not a swap race
+                last = exc         # racing a close(): retry current entry
+                continue
             except log.LightGBMError as exc:
+                self._record_outcome(entry, exc)
                 if "closed" not in str(exc):
-                    raise
+                    raise          # client/config error: caller's fault
                 last = exc
+                continue
+            except Exception as exc:
+                self._record_outcome(entry, exc)
+                raise
+            if sync:
+                self._record_outcome(entry, None)
+            else:
+                # submit(): the outcome is async — record it into the
+                # breaker of the entry that SERVED the future (a model
+                # swapped out mid-flight keeps its own history; a
+                # cancelled future records nothing)
+                result.add_done_callback(
+                    lambda f, e=entry: self._record_outcome(
+                        e, FutureCancelledError() if f.cancelled()
+                        else f.exception()))
+            self._enforce_budget(exclude=name)
+            return result
         raise last
 
-    def predict(self, name: str, data, **overrides):
+    def predict(self, name: str, data, deadline_ms: Optional[float] = None,
+                **overrides):
         return self._with_predictor(
-            name, lambda p: p.predict(data, **overrides))
+            name,
+            lambda p: p.predict(data, deadline_ms=deadline_ms, **overrides))
 
-    def predict_one(self, name: str, row, **overrides):
+    def predict_one(self, name: str, row,
+                    deadline_ms: Optional[float] = None, **overrides):
         return self._with_predictor(
-            name, lambda p: p.predict_one(row, **overrides))
+            name,
+            lambda p: p.predict_one(row, deadline_ms=deadline_ms,
+                                    **overrides))
 
-    def submit(self, name: str, row):
-        return self._with_predictor(name, lambda p: p.submit(row))
+    def submit(self, name: str, row,
+               deadline_ms: Optional[float] = None) -> Future:
+        return self._with_predictor(
+            name, lambda p: p.submit(row, deadline_ms=deadline_ms),
+            sync=False)
 
     def predictor(self, name: str) -> Predictor:
         """The current Predictor for `name` (hot swaps rebind the name;
@@ -319,6 +454,10 @@ class ModelRegistry:
             ps["publish_version"] = e.publish_version
             ps["registry_requests"] = e.requests
             ps["stack_bytes"] = per_model.get(e.name, 0)
+            if e.breaker is not None:
+                ps["breaker"] = e.breaker.stats()
+            if e.bucket is not None:
+                ps["qps_limit"] = e.bucket.rate
             out["models"][e.name] = ps
         self._mirror_gauges()
         return out

@@ -23,6 +23,14 @@ at tier-1 speed:
   answering" shape the collective watchdog must convert into a clean
   `RC_RANK_FAILURE` exit); `fail_next_collective(n)` fails the next n
   grower dispatches.
+- serving fault shapes (ISSUE 12): `slow_predict(seconds)` makes EVERY
+  predict dispatch take `seconds` (a saturated/slow device — the shape
+  the admission layer's shedding must degrade gracefully under, so
+  unlike `wedge` it does not pop after one call); `fail_predict(n)`
+  fails the next n predict dispatches (trips the registry's per-model
+  circuit breaker); `compile_storm(seconds)` wedges every cold-bucket
+  FIRST compile (the single-flight leader) for `seconds`, so tests can
+  prove N concurrent cold requests pay exactly one compile.
 - `corrupt_file` / `truncate_file` — bit-flip or cut a checkpoint on
   disk to exercise the checksum-validation / fall-back-to-previous path.
 
@@ -69,11 +77,15 @@ class FaultPlan:
     def __init__(self, kill_at_iteration: Optional[int] = None,
                  fail: Optional[Dict[str, int]] = None,
                  wedge: Optional[Dict[str, float]] = None,
-                 kill_rank: Optional[Tuple[int, int]] = None):
+                 kill_rank: Optional[Tuple[int, int]] = None,
+                 slow: Optional[Dict[str, float]] = None):
         self.kill_at_iteration = kill_at_iteration
         self.fail = dict(fail or {})
         # site -> seconds: the next call through the site sleeps (once)
         self.wedge = {k: float(v) for k, v in (wedge or {}).items()}
+        # site -> seconds: EVERY call through the site sleeps (sustained
+        # slowness, the overload shape — wedge is for one-shot hangs)
+        self.slow = {k: float(v) for k, v in (slow or {}).items()}
         # (rank, at_iteration): preempt only that rank
         self.kill_rank = tuple(kill_rank) if kill_rank else None
         self.fired: List[str] = []   # audit log of injected faults
@@ -106,7 +118,8 @@ def _load_env_plan() -> None:
             kill_at_iteration=d.get("kill_at_iteration"),
             fail=d.get("fail"),
             wedge=d.get("wedge"),
-            kill_rank=d.get("kill_rank"))
+            kill_rank=d.get("kill_rank"),
+            slow=d.get("slow"))
     except (ValueError, TypeError) as exc:
         raise ValueError(
             f"Unparseable {FAULT_PLAN_ENV}: {spec!r} ({exc})") from exc
@@ -122,28 +135,38 @@ def inject(site: str, iteration: Optional[int] = None) -> None:
         _load_env_plan()
         if _plan is None:
             return
+    # snapshot: a serving test's main thread may reset() while a
+    # batcher thread is mid-sleep inside a slow/wedge injection — the
+    # rest of this call must keep operating on the plan it started with
+    plan = _plan
     if site == "train.iteration" and iteration is not None:
-        if (_plan.kill_at_iteration is not None
-                and iteration >= _plan.kill_at_iteration):
-            _plan.fired.append(f"kill@{iteration}")
+        if (plan.kill_at_iteration is not None
+                and iteration >= plan.kill_at_iteration):
+            plan.fired.append(f"kill@{iteration}")
             raise SimulatedPreemption(iteration)
-        if (_plan.kill_rank is not None
-                and iteration >= _plan.kill_rank[1]
-                and _current_rank() == _plan.kill_rank[0]):
-            _plan.fired.append(
-                f"kill_rank{_plan.kill_rank[0]}@{iteration}")
+        if (plan.kill_rank is not None
+                and iteration >= plan.kill_rank[1]
+                and _current_rank() == plan.kill_rank[0]):
+            plan.fired.append(
+                f"kill_rank{plan.kill_rank[0]}@{iteration}")
             raise SimulatedPreemption(iteration)
-    secs = _plan.wedge.pop(site, None)
+    secs = plan.wedge.pop(site, None)
     if secs is not None:
         # the wedge shape: the call BLOCKS (peer stopped answering) —
         # one-shot, so a watchdog-less run eventually continues and a
         # watchdog-armed run has exactly one deadline violation to catch
-        _plan.fired.append(f"wedge@{site}")
+        plan.fired.append(f"wedge@{site}")
         time.sleep(secs)
-    remaining = _plan.fail.get(site, 0)
+    secs = plan.slow.get(site)
+    if secs is not None:
+        # sustained slowness: EVERY call pays it (a saturated device /
+        # a long compile) — the overload harness's capacity knob
+        plan.fired.append(f"slow@{site}")
+        time.sleep(secs)
+    remaining = plan.fail.get(site, 0)
     if remaining > 0:
-        _plan.fail[site] = remaining - 1
-        _plan.fired.append(site)
+        plan.fail[site] = remaining - 1
+        plan.fired.append(site)
         raise InjectedFault(site)
 
 
@@ -151,12 +174,13 @@ def inject(site: str, iteration: Optional[int] = None) -> None:
 def active(kill_at_iteration: Optional[int] = None,
            fail: Optional[Dict[str, int]] = None,
            wedge: Optional[Dict[str, float]] = None,
-           kill_rank: Optional[Tuple[int, int]] = None):
+           kill_rank: Optional[Tuple[int, int]] = None,
+           slow: Optional[Dict[str, float]] = None):
     """Arm a fault plan for the duration of the with-block."""
     global _plan
     prev = _plan
     _plan = FaultPlan(kill_at_iteration=kill_at_iteration, fail=fail,
-                      wedge=wedge, kill_rank=kill_rank)
+                      wedge=wedge, kill_rank=kill_rank, slow=slow)
     try:
         yield _plan
     finally:
@@ -192,6 +216,37 @@ def fail_next_collective(n: int) -> FaultPlan:
     """Fail the next `n` grower collective dispatches."""
     plan = _ensure_plan()
     plan.fail["collective.call"] = plan.fail.get("collective.call", 0) + int(n)
+    return plan
+
+
+# ---------------------------------------------------------------------------
+# serving fault shapes (ISSUE 12)
+# ---------------------------------------------------------------------------
+def slow_predict(seconds: float) -> FaultPlan:
+    """Make EVERY serving predict dispatch take `seconds` — the
+    saturated-device shape driving the overload gate (capacity becomes
+    a knob: micro_batch rows / `seconds` per dispatch)."""
+    plan = _ensure_plan()
+    plan.slow["serving.predict"] = float(seconds)
+    return plan
+
+
+def fail_predict(n: int) -> FaultPlan:
+    """Fail the next `n` serving predict dispatches (the repeated-
+    failure shape the registry's per-model circuit breaker trips on)."""
+    plan = _ensure_plan()
+    plan.fail["serving.predict"] = plan.fail.get("serving.predict", 0) \
+        + int(n)
+    return plan
+
+
+def compile_storm(seconds: float = 0.25) -> FaultPlan:
+    """Wedge every cold-bucket FIRST compile (the single-flight leader
+    in serving/predictor.py) for `seconds`: N concurrent first requests
+    on an unseen shape bucket then demonstrably pay ONE simulated
+    trace, while the followers wait under their deadlines or shed."""
+    plan = _ensure_plan()
+    plan.slow["serving.compile"] = float(seconds)
     return plan
 
 

@@ -152,6 +152,41 @@ DESCRIPTIONS = {
                              "least-recently-used models' stacks are "
                              "evicted past it (host trees stay, the "
                              "next request restacks)",
+    "tpu_serving_max_queue": "max queued Predictor.submit() requests; "
+                             "past it new requests are refused with a "
+                             "structured retriable ServingOverload "
+                             "(reason queue_full) instead of queueing "
+                             "late (0 = unbounded)",
+    "tpu_serving_max_inflight": "max concurrent synchronous predict() "
+                                "calls per Predictor; excess requests "
+                                "are refused with reason inflight_full "
+                                "(0 = unbounded)",
+    "tpu_serving_deadline_ms": "default per-request deadline: requests "
+                               "whose EWMA-estimated queue wait already "
+                               "exceeds it are shed at admission, and "
+                               "requests that expire while queued fail "
+                               "with DeadlineExceeded before any device "
+                               "work; per-call deadline_ms= overrides "
+                               "(0 = no deadline)",
+    "tpu_serving_model_qps": "per-model token-bucket rate in "
+                             "serving.ModelRegistry (tokens/s, burst = "
+                             "one second's worth; 0 = unlimited): a hot "
+                             "model sheds with reason rate_limited "
+                             "instead of starving other residents",
+    "tpu_serving_breaker_failures": "consecutive predict failures "
+                                    "before a model's circuit breaker "
+                                    "opens (overload rejections never "
+                                    "count; 0 disables the breaker)",
+    "tpu_serving_breaker_reset_s": "seconds an open breaker waits "
+                                   "before half-opening for a single "
+                                   "probe; failed probes re-open with "
+                                   "exponential backoff",
+    "tpu_compile_cache_dir": "persistent XLA compilation cache "
+                             "directory: bucket-ladder and grower "
+                             "programs persist to disk so restarted "
+                             "trainers / cold serving replicas warm "
+                             "from a file read instead of re-tracing "
+                             "(empty = package default)",
     "tpu_predict_warmup_rows": "Predictor.warmup() compiles bucket "
                                "programs up to this many rows",
     "tpu_predict_micro_batch": "max concurrent single-row requests "
