@@ -15,6 +15,10 @@ BENCH_SHAPE=overload runs the serving overload-resilience gate
 bounded admitted p99, circuit-breaker trip/recovery, single-flight
 compile storm, persistent-compile-cache cold start — commits
 OVERLOAD_r01.json).
+BENCH_SHAPE=lint runs the graftlint static-analysis gate
+(scripts/lint_report.py: zero unsuppressed findings over lightgbm_tpu/
+and scripts/, every suppression carrying a written reason, no stale
+baseline entries — commits LINT_r01.json).
 BENCH_SHAPE=elastic runs the kill->shrink->resume supervisor cycle
 (scripts/elastic_smoke.py: rank killed at W=4, wedged collective
 detected by the watchdog, elastic resume at W'=2 then W'=1,
@@ -945,6 +949,18 @@ def run_elastic() -> dict:
                     os.environ.get("BENCH_ELASTIC_MODE", "devices")])
 
 
+def run_lint() -> dict:
+    """Static-analysis gate (BENCH_SHAPE=lint): run graftlint over the
+    package + scripts in a child (no backend involved) and commit the
+    machine-readable artifact (LINT_r01.json: per-rule counts, zero
+    unsuppressed findings, suppressions with their written reasons)."""
+    return _run_smoke_gate(
+        "lint_report.py",
+        os.environ.get("BENCH_LINT_OUT",
+                       os.path.join(REPO, "LINT_r01.json")),
+        "BENCH_LINT_TIMEOUT", "lint_zero_unsuppressed_findings")
+
+
 def run_overload() -> dict:
     """Overload-resilience gate (BENCH_SHAPE=overload): run the serving
     tier's admission/shedding/breaker/cold-start smoke headlessly and
@@ -978,6 +994,11 @@ def main():
         # dryrun gate — a dead TPU relay must not hang the harness)
         for entry in run_multichip():
             print(json.dumps(entry), flush=True)
+        return
+    if which == "lint":
+        # pure source analysis in a child; the parent (and the child)
+        # never need a backend
+        print(json.dumps(run_lint()), flush=True)
         return
     if which == "elastic":
         print(json.dumps(run_elastic()), flush=True)

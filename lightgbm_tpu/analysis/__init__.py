@@ -1,0 +1,42 @@
+"""graftlint: project-native static analysis for the TPU-hazard
+invariants this repo keeps re-learning the hard way.
+
+The hardest shipped bugs were violations of UNWRITTEN project
+invariants: PR 11's bagging/GOSS masks drawn over the padded row count
+(in-bag selection silently depended on the device count), PR 12's
+check-then-act races on serving counters, and the hand-maintained
+`tpu_*` param <-> docs <-> checkpoint-fingerprint triangle. Accelerator
+GBDTs win by guaranteeing bit-level reproducibility across device
+layouts; enforcing that only with after-the-fact bit-identity tests
+means every new subsystem can re-introduce the same bug classes. This
+package makes the invariants machine-checked at the source level.
+
+Usage::
+
+    python -m lightgbm_tpu.analysis lightgbm_tpu scripts          # text
+    python -m lightgbm_tpu.analysis --json lightgbm_tpu scripts   # CI
+    python -m lightgbm_tpu.analysis --list-rules
+
+Suppressing a finding requires a WRITTEN reason, inline::
+
+    risky()  # graftlint: disable=<rule>  <why the rule does not apply>
+
+or a baseline entry (graftlint_baseline.json) with a `reason` field.
+Reasonless pragmas, unknown rule names in pragmas, and reasonless
+baseline entries are themselves findings. The pass runs as a tier-1
+pytest (tests/test_static_analysis.py): zero unsuppressed findings
+over `lightgbm_tpu/` and `scripts/` is a merge gate.
+
+Rules live in `lightgbm_tpu/analysis/rules/` — one module per bug
+class, each pinned by positive/negative fixtures under
+tests/analysis_fixtures/. See README "Static analysis" for how to add
+one.
+"""
+from __future__ import annotations
+
+from .core import (Finding, Report, Rule, SourceFile, Suppression,  # noqa: F401
+                   iter_python_files, run)
+from .rules import RULE_CLASSES, all_rules  # noqa: F401
+
+__all__ = ["Finding", "Report", "Rule", "SourceFile", "Suppression",
+           "iter_python_files", "run", "all_rules", "RULE_CLASSES"]

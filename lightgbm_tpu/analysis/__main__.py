@@ -1,0 +1,101 @@
+"""graftlint CLI: `python -m lightgbm_tpu.analysis [paths...]`.
+
+Exit code 0 iff no unsuppressed findings. `--json` emits the
+machine-readable report (schema graftlint/1) on stdout for CI gates
+(scripts/lint_report.py wraps this into the committed LINT artifact).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from . import all_rules, run
+
+DEFAULT_BASELINE = "graftlint_baseline.json"
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m lightgbm_tpu.analysis",
+        description="graftlint: project-native static analysis "
+                    "enforcing the repo's TPU-hazard invariants")
+    p.add_argument("paths", nargs="*", default=None,
+                   help="files/directories to scan (default: "
+                        "lightgbm_tpu scripts, resolved against the "
+                        "repo root this package lives in)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable report on stdout")
+    p.add_argument("--baseline", default=None,
+                   help="baseline file for grandfathered findings "
+                        "(default: ./%s if present; every entry needs "
+                        "a reason)" % DEFAULT_BASELINE)
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore any baseline file")
+    p.add_argument("--rules", default=None,
+                   help="comma-separated rule subset to run")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule registry and exit")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    rules = all_rules()
+    if args.list_rules:
+        for r in rules:
+            print(f"{r.name}: {r.description}")
+        return 0
+
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    paths = args.paths
+    if not paths:
+        paths = [p for p in (os.path.join(repo, "lightgbm_tpu"),
+                             os.path.join(repo, "scripts"))
+                 if os.path.isdir(p)]
+
+    baseline = None
+    if not args.no_baseline:
+        baseline = args.baseline
+        if baseline is None:
+            # cwd first (a scanned subtree may carry its own), then the
+            # repo root the default scan paths anchor to — running the
+            # CLI from a subdirectory must not silently drop the
+            # committed baseline
+            for cand in (DEFAULT_BASELINE,
+                         os.path.join(repo, DEFAULT_BASELINE)):
+                if os.path.exists(cand):
+                    baseline = cand
+                    break
+
+    rule_names = None
+    if args.rules:
+        rule_names = [r.strip() for r in args.rules.split(",")
+                      if r.strip()]
+    try:
+        report = run(paths, rule_names=rule_names, baseline_path=baseline)
+    except ValueError as exc:  # unknown rule name
+        print(f"graftlint: {exc}", file=sys.stderr)
+        return 2
+
+    if args.as_json:
+        print(json.dumps(report.as_dict(), indent=2, sort_keys=True))
+        return report.exit_code
+
+    for f in report.findings:
+        print(f.render())
+    n_sup = len(report.suppressions)
+    print("graftlint: %d file(s), %d finding(s), %d suppressed%s"
+          % (report.files_scanned, len(report.findings), n_sup,
+             "" if not report.stale_baseline
+             else ", %d STALE baseline entr%s (prune them)"
+             % (len(report.stale_baseline),
+                "y" if len(report.stale_baseline) == 1 else "ies")))
+    return report.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,433 @@
+"""graftlint core: finding model, pragma suppression, baseline, walker.
+
+The engine is deliberately small: rules are AST visitors over parsed
+source (never imported, never executed — a broken module is itself a
+finding), findings are suppressible only with a WRITTEN reason (inline
+pragma or baseline entry), and the whole pass is a tier-1 pytest so the
+invariants it encodes are enforced on every run, not re-learned from
+the next production incident.
+
+Suppression contract:
+
+- inline pragma, same line as the finding::
+
+      risky_call()  # graftlint: disable=<rule>[,<rule2>]  <reason>
+
+  The reason is MANDATORY — a pragma without one is itself a finding
+  (rule ``pragma-missing-reason``), and naming a rule the engine does
+  not know is a finding too (``pragma-unknown-rule``), so suppressions
+  cannot rot silently when a rule is renamed.
+- baseline file (``graftlint_baseline.json``) for grandfathered
+  findings: entries match on (rule, path, message) and must carry a
+  non-empty ``reason``. Entries that no longer match anything are
+  reported as stale so the baseline shrinks monotonically.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import io
+import json
+import os
+import tokenize
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+SCHEMA = "graftlint/1"
+
+# directories the file walker never descends into: bytecode caches and
+# tool/VCS state are not source (satellite: no __pycache__ may ever be
+# scanned OR committed — .gitignore handles the committing half)
+SKIP_DIRS = {"__pycache__", ".git", ".pytest_cache", ".mypy_cache",
+             ".ruff_cache", "node_modules", ".ipynb_checkpoints"}
+
+PRAGMA_RULES = ("pragma-missing-reason", "pragma-unknown-rule",
+                "baseline-missing-reason", "parse-error")
+
+
+@dataclasses.dataclass
+class Finding:
+    """One rule violation at one source location."""
+    rule: str
+    path: str           # repo-relative (or as-given) posix path
+    line: int
+    col: int
+    message: str
+
+    @property
+    def key(self) -> str:
+        """Stable identity for baseline matching: deliberately excludes
+        line/col so unrelated edits above a grandfathered finding do not
+        un-suppress it."""
+        h = hashlib.sha256(
+            f"{self.rule}|{self.path}|{self.message}".encode()).hexdigest()
+        return h[:16]
+
+    def as_dict(self) -> Dict[str, object]:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message, "key": self.key}
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule}: " \
+               f"{self.message}"
+
+
+@dataclasses.dataclass
+class Suppression:
+    finding: Finding
+    via: str            # "pragma" | "baseline"
+    reason: str
+
+    def as_dict(self) -> Dict[str, object]:
+        d = self.finding.as_dict()
+        d["via"] = self.via
+        d["reason"] = self.reason
+        return d
+
+
+@dataclasses.dataclass
+class Pragma:
+    line: int
+    rules: Tuple[str, ...]
+    reason: str
+    col: int = 0
+
+
+class SourceFile:
+    """One parsed source file handed to every rule: path, text, AST,
+    and the pragma table. Parse failures surface as findings instead of
+    crashing the pass (a module that cannot parse cannot be checked —
+    and cannot run either)."""
+
+    def __init__(self, path: str, display_path: str, text: str):
+        self.path = path
+        self.display_path = display_path
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree: Optional[ast.AST] = None
+        self.parse_error: Optional[str] = None
+        try:
+            self.tree = ast.parse(text)
+        except SyntaxError as exc:
+            self.parse_error = f"{exc.msg} (line {exc.lineno})"
+        self.pragmas: List[Pragma] = _collect_pragmas(text)
+
+    def finding(self, rule: str, node, message: str) -> Finding:
+        line = getattr(node, "lineno", 0) if node is not None else 0
+        col = getattr(node, "col_offset", 0) if node is not None else 0
+        return Finding(rule=rule, path=self.display_path, line=line,
+                       col=col, message=message)
+
+
+def _collect_pragmas(text: str) -> List[Pragma]:
+    """Pragmas ride COMMENT tokens (tokenize, not regex-over-lines, so a
+    '# graftlint:' inside a string literal is never misread)."""
+    out: List[Pragma] = []
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(text).readline)
+        comments = [(t.start[0], t.start[1], t.string) for t in tokens
+                    if t.type == tokenize.COMMENT]
+    except (tokenize.TokenError, SyntaxError, IndentationError):
+        return out
+    for line, col, comment in comments:
+        body = comment.lstrip("#").strip()
+        if not body.startswith("graftlint:"):
+            continue
+        body = body[len("graftlint:"):].strip()
+        if not body.startswith("disable="):
+            continue
+        rest = body[len("disable="):]
+        # rule list runs to the first whitespace; everything after is
+        # the mandatory reason
+        parts = rest.split(None, 1)
+        rules = tuple(r.strip() for r in parts[0].split(",") if r.strip())
+        reason = parts[1].strip() if len(parts) > 1 else ""
+        out.append(Pragma(line=line, rules=rules, reason=reason, col=col))
+    return out
+
+
+class Rule:
+    """Base class. Subclasses set `name`/`description` and override
+    `check_file` (per-file findings) and/or `check_project` (cross-file
+    findings over the whole scanned set, e.g. config-hygiene)."""
+
+    name: str = ""
+    description: str = ""
+
+    def check_file(self, src: SourceFile) -> Iterable[Finding]:
+        return ()
+
+    def check_project(self, files: Sequence[SourceFile]) -> Iterable[Finding]:
+        return ()
+
+
+# ---------------------------------------------------------------------------
+# walker
+# ---------------------------------------------------------------------------
+def iter_python_files(paths: Sequence[str]) -> List[Tuple[str, str]]:
+    """(abs_path, display_path) for every .py under `paths` (files or
+    directories), skipping bytecode caches and VCS/tool state. Display
+    paths stay relative to the common parent of the inputs so findings
+    and baseline entries are machine-portable."""
+    out: List[Tuple[str, str]] = []
+    for p in paths:
+        ap = os.path.abspath(p)
+        base = os.path.dirname(ap)
+        if os.path.isfile(ap):
+            if ap.endswith(".py"):
+                out.append((ap, _display_file(ap)))
+            continue
+        for root, dirs, names in os.walk(ap):
+            dirs[:] = sorted(d for d in dirs
+                             if d not in SKIP_DIRS and not d.startswith("."))
+            for name in sorted(names):
+                if name.endswith(".py"):
+                    full = os.path.join(root, name)
+                    out.append((full, _display(full, base)))
+    seen = set()
+    uniq = []
+    for ap, disp in out:
+        if ap not in seen:
+            seen.add(ap)
+            uniq.append((ap, disp))
+    return uniq
+
+
+def _display(path: str, base: str) -> str:
+    try:
+        rel = os.path.relpath(path, base)
+    except ValueError:  # different drive (windows)
+        rel = path
+    return rel.replace(os.sep, "/")
+
+
+def _display_file(path: str) -> str:
+    """A bare FILE input must keep its directory context — path-scoped
+    rules (stdout-print's `lightgbm_tpu` segment, serving-lock's
+    `/serving/`) match on directory segments, and a bare basename would
+    silently disable them. Use the cwd-relative path when the file is
+    under the cwd (the `python -m lightgbm_tpu.analysis some/file.py`
+    case), else the absolute path."""
+    try:
+        rel = os.path.relpath(path)
+    except ValueError:  # different drive (windows)
+        return path.replace(os.sep, "/")
+    if rel.startswith(".."):
+        return path.replace(os.sep, "/")
+    return rel.replace(os.sep, "/")
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+class BaselineError(ValueError):
+    """The baseline file itself is malformed (bad JSON, missing
+    reasons); surfaced as findings so CI fails loudly."""
+
+
+def load_baseline(path: str) -> Tuple[List[Dict[str, str]], List[Finding]]:
+    """Returns (entries, findings-about-the-baseline-itself)."""
+    findings: List[Finding] = []
+    try:
+        with open(path) as fh:
+            doc = json.load(fh)
+    except FileNotFoundError:
+        return [], []
+    except (OSError, json.JSONDecodeError) as exc:
+        findings.append(Finding(
+            rule="parse-error", path=path.replace(os.sep, "/"), line=0,
+            col=0, message=f"unreadable baseline file: {exc}"))
+        return [], findings
+    entries = doc.get("entries", []) if isinstance(doc, dict) else []
+    ok: List[Dict[str, str]] = []
+    for i, e in enumerate(entries):
+        if not isinstance(e, dict) or not e.get("rule") or not e.get("path"):
+            findings.append(Finding(
+                rule="parse-error", path=path.replace(os.sep, "/"),
+                line=0, col=0,
+                message=f"baseline entry {i} needs 'rule' and 'path'"))
+            continue
+        if not str(e.get("reason", "")).strip():
+            findings.append(Finding(
+                rule="baseline-missing-reason",
+                path=path.replace(os.sep, "/"), line=0, col=0,
+                message="baseline entry %d (%s @ %s) has no written "
+                        "justification — every grandfathered finding "
+                        "must say WHY it is allowed to stand"
+                        % (i, e.get("rule"), e.get("path"))))
+            continue
+        ok.append(e)
+    return ok, findings
+
+
+def _baseline_matches(entry: Dict[str, str], finding: Finding) -> bool:
+    if entry.get("rule") != finding.rule:
+        return False
+    if entry.get("path") != finding.path:
+        return False
+    if "key" in entry:
+        return str(entry["key"]) == finding.key
+    return str(entry.get("message", "")) == finding.message
+
+
+# ---------------------------------------------------------------------------
+# engine
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass
+class Report:
+    paths: List[str]
+    files_scanned: int
+    findings: List[Finding]              # unsuppressed
+    suppressions: List[Suppression]
+    rule_counts: Dict[str, Dict[str, int]]
+    baseline_path: Optional[str]
+    baseline_entries: int
+    stale_baseline: List[Dict[str, str]]
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if self.findings else 0
+
+    def as_dict(self) -> Dict[str, object]:
+        return {
+            "schema": SCHEMA,
+            "paths": list(self.paths),
+            "files_scanned": self.files_scanned,
+            "rules": self.rule_counts,
+            "findings": [f.as_dict() for f in self.findings],
+            "suppressions": [s.as_dict() for s in self.suppressions],
+            "baseline": {
+                "path": self.baseline_path,
+                "entries": self.baseline_entries,
+                "stale": list(self.stale_baseline),
+            },
+            "exit_code": self.exit_code,
+        }
+
+
+def run(paths: Sequence[str], rules: Optional[Sequence[Rule]] = None,
+        rule_names: Optional[Sequence[str]] = None,
+        baseline_path: Optional[str] = None) -> Report:
+    """Run the pass. `rules` overrides the registry (tests); otherwise
+    `rule_names` selects from it (None = all)."""
+    if rules is None:
+        from .rules import all_rules
+        rules = all_rules(rule_names)
+    known = {r.name for r in rules} | set(PRAGMA_RULES)
+    # a pragma naming a REGISTERED rule stays valid when only a subset
+    # runs (conftest's fail-fast stdout gate must not flag suppressions
+    # aimed at the full tier-1 pass); truly unknown names still fail
+    try:
+        from .rules import RULE_CLASSES
+        known |= {cls.name for cls in RULE_CLASSES}
+    except ImportError:  # pragma: no cover - registry always importable
+        pass
+
+    file_pairs = iter_python_files(paths)
+    files: List[SourceFile] = []
+    raw: List[Finding] = []
+    for abs_path, disp in file_pairs:
+        try:
+            with open(abs_path, encoding="utf-8", errors="replace") as fh:
+                text = fh.read()
+        except OSError as exc:
+            raw.append(Finding(rule="parse-error", path=disp, line=0,
+                               col=0, message=f"unreadable: {exc}"))
+            continue
+        src = SourceFile(abs_path, disp, text)
+        files.append(src)
+        if src.parse_error is not None:
+            raw.append(src.finding(
+                "parse-error", None,
+                f"module does not parse: {src.parse_error}"))
+
+    # pragma hygiene findings, independent of whether the pragma ends up
+    # suppressing anything — a malformed suppression must not lurk
+    for src in files:
+        for pragma in src.pragmas:
+            if not pragma.reason:
+                raw.append(Finding(
+                    rule="pragma-missing-reason", path=src.display_path,
+                    line=pragma.line, col=pragma.col,
+                    message="graftlint pragma has no reason — write WHY "
+                            "the rule does not apply here (format: "
+                            "# graftlint: disable=<rule>  <reason>)"))
+            for r in pragma.rules:
+                if r not in known:
+                    raw.append(Finding(
+                        rule="pragma-unknown-rule", path=src.display_path,
+                        line=pragma.line, col=pragma.col,
+                        message=f"pragma names unknown rule {r!r} "
+                                f"(known: {', '.join(sorted(known))})"))
+
+    for src in files:
+        if src.tree is None:
+            continue
+        for rule in rules:
+            for f in rule.check_file(src):
+                raw.append(f)
+    for rule in rules:
+        for f in rule.check_project(files):
+            raw.append(f)
+
+    baseline_entries: List[Dict[str, str]] = []
+    if baseline_path:
+        baseline_entries, bfindings = load_baseline(baseline_path)
+        raw.extend(bfindings)
+
+    pragma_by_file = {src.display_path: src.pragmas for src in files}
+    findings: List[Finding] = []
+    suppressions: List[Suppression] = []
+    matched_entries: set = set()
+    for f in sorted(raw, key=lambda f: (f.path, f.line, f.rule)):
+        sup = _pragma_for(pragma_by_file.get(f.path, ()), f)
+        if sup is not None:
+            suppressions.append(Suppression(f, "pragma", sup.reason))
+            continue
+        matched = None
+        for i, entry in enumerate(baseline_entries):
+            if _baseline_matches(entry, f):
+                matched = (i, entry)
+                break
+        if matched is not None:
+            matched_entries.add(matched[0])
+            suppressions.append(
+                Suppression(f, "baseline", str(matched[1]["reason"])))
+            continue
+        findings.append(f)
+
+    stale = [e for i, e in enumerate(baseline_entries)
+             if i not in matched_entries]
+
+    counts: Dict[str, Dict[str, int]] = {}
+    for r in rules:
+        counts[r.name] = {"description": r.description,  # type: ignore
+                          "findings": 0, "suppressed": 0}
+    for name in PRAGMA_RULES:
+        counts.setdefault(name, {"description": "engine hygiene",
+                                 "findings": 0, "suppressed": 0})
+    for f in findings:
+        counts.setdefault(f.rule, {"findings": 0, "suppressed": 0})
+        counts[f.rule]["findings"] += 1
+    for s in suppressions:
+        counts.setdefault(s.finding.rule, {"findings": 0, "suppressed": 0})
+        counts[s.finding.rule]["suppressed"] += 1
+
+    return Report(paths=[str(p) for p in paths], files_scanned=len(files),
+                  findings=findings, suppressions=suppressions,
+                  rule_counts=counts, baseline_path=baseline_path,
+                  baseline_entries=len(baseline_entries),
+                  stale_baseline=stale)
+
+
+def _pragma_for(pragmas: Sequence[Pragma], f: Finding) -> Optional[Pragma]:
+    """A pragma suppresses a finding on its own line only, and only
+    with a written reason (a reasonless pragma suppresses nothing — it
+    is itself a finding). Pragma-hygiene findings are never
+    self-suppressible."""
+    if f.rule in ("pragma-missing-reason", "pragma-unknown-rule"):
+        return None
+    for p in pragmas:
+        if p.line == f.line and f.rule in p.rules and p.reason:
+            return p
+    return None

@@ -1,0 +1,43 @@
+"""graftlint rule registry.
+
+Each rule targets a bug class this repo has actually shipped (see the
+per-rule docstrings for the incident that motivated it). Adding a rule:
+subclass `core.Rule`, give it a kebab-case `name` + one-line
+`description`, implement `check_file` (per parsed module) and/or
+`check_project` (cross-file), register it here, and pin its semantics
+with positive/negative fixtures under tests/analysis_fixtures/<name>/.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from ..core import Rule
+from .padded_rng import PaddedRngRule
+from .collectives import UnguardedCollectiveRule
+from .host_sync import TracedHostSyncRule
+from .config_hygiene import ConfigHygieneRule
+from .serving_locks import FutureGuardRule, ServingLockRule
+from .stdout_print import StdoutPrintRule
+
+RULE_CLASSES = (
+    PaddedRngRule,
+    UnguardedCollectiveRule,
+    TracedHostSyncRule,
+    ConfigHygieneRule,
+    ServingLockRule,
+    FutureGuardRule,
+    StdoutPrintRule,
+)
+
+
+def all_rules(names: Optional[Sequence[str]] = None) -> List[Rule]:
+    rules = [cls() for cls in RULE_CLASSES]
+    if names is None:
+        return rules
+    known = {r.name for r in rules}
+    unknown = set(names) - known
+    if unknown:
+        raise ValueError("unknown rule(s): %s (known: %s)"
+                         % (", ".join(sorted(unknown)),
+                            ", ".join(sorted(known))))
+    return [r for r in rules if r.name in set(names)]

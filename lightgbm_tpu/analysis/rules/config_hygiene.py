@@ -1,0 +1,217 @@
+"""Rule `config-hygiene`: the tpu_* param / docs / fingerprint triangle.
+
+Every `tpu_*` parameter lives in three places that have historically
+been hand-synchronized and have repeatedly drifted: the dataclass
+declaration in `config.py`, the generated `docs/Parameters.md`, and
+the checkpoint-fingerprint classification in `checkpoint.py` (a param
+either participates in the resume fingerprint or is explicitly listed
+as excluded — PR 3/8/11/12 each re-discovered a missing exclusion the
+hard way). This rule makes the triangle machine-checked:
+
+for every `tpu_*` field of a dataclass in a scanned `config.py`:
+
+1. **validation** — the field must have an entry in config.py's
+   `TPU_PARAM_SPEC` table (the declarative bounds/choices table
+   `check_param_conflict` applies), so no tpu_* knob ships without a
+   validation decision; stale spec entries naming no field are errors
+   too.
+2. **docs** — the field name must appear in `docs/Parameters.md`
+   (sibling `docs/` of the package directory), i.e. the doc was
+   regenerated after the param landed.
+3. **fingerprint** — the field must appear in EXACTLY ONE of
+   checkpoint.py's `_FINGERPRINT_EXCLUDE` (resume may legitimately
+   differ) or `_FINGERPRINT_INCLUDED` (participates in the fingerprint;
+   resume refuses on mismatch). Unclassified and double-classified are
+   both errors, as are stale tpu_* names in either list.
+
+Scope: fires only when a scanned file is a `config.py` declaring
+dataclass fields named `tpu_*` — fixture trees mirror that layout.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from ..core import Finding, Rule, SourceFile
+
+SPEC_TABLE = "TPU_PARAM_SPEC"
+EXCLUDE_SET = "_FINGERPRINT_EXCLUDE"
+INCLUDE_SET = "_FINGERPRINT_INCLUDED"
+
+
+def _dataclass_tpu_fields(tree: ast.AST) -> Dict[str, ast.AST]:
+    """tpu_* AnnAssign fields of @dataclass classes: name -> node."""
+    out: Dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        deco_names = set()
+        for d in node.decorator_list:
+            target = d.func if isinstance(d, ast.Call) else d
+            if isinstance(target, ast.Name):
+                deco_names.add(target.id)
+            elif isinstance(target, ast.Attribute):
+                deco_names.add(target.attr)
+        if "dataclass" not in deco_names:
+            continue
+        for stmt in node.body:
+            if isinstance(stmt, ast.AnnAssign) and \
+                    isinstance(stmt.target, ast.Name) and \
+                    stmt.target.id.startswith("tpu_"):
+                out[stmt.target.id] = stmt
+    return out
+
+
+def _string_collection(tree: ast.AST, var_name: str) \
+        -> Optional[Tuple[Set[str], ast.AST]]:
+    """String elements of a module-level set/tuple/list/dict-keys
+    assignment named `var_name`."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == var_name
+                   for t in node.targets):
+            continue
+        value = node.value
+        elems: Sequence[ast.AST]
+        if isinstance(value, (ast.Set, ast.Tuple, ast.List)):
+            elems = value.elts
+        elif isinstance(value, ast.Dict):
+            elems = [k for k in value.keys if k is not None]
+        else:
+            return set(), node
+        return ({e.value for e in elems
+                 if isinstance(e, ast.Constant)
+                 and isinstance(e.value, str)}, node)
+    return None
+
+
+class ConfigHygieneRule(Rule):
+    name = "config-hygiene"
+    description = ("tpu_* param drift across config.py validation "
+                   "spec, docs/Parameters.md, and checkpoint.py "
+                   "fingerprint classification")
+
+    def check_project(self, files: Sequence[SourceFile]) \
+            -> Iterable[Finding]:
+        out: List[Finding] = []
+        for src in files:
+            if src.tree is None or \
+                    os.path.basename(src.path) != "config.py":
+                continue
+            fields = _dataclass_tpu_fields(src.tree)
+            if not fields:
+                continue
+            out.extend(self._check_triangle(src, fields, files))
+        return out
+
+    def _check_triangle(self, cfg: SourceFile,
+                        fields: Dict[str, ast.AST],
+                        files: Sequence[SourceFile]) -> List[Finding]:
+        out: List[Finding] = []
+        pkg_dir = os.path.dirname(cfg.path)
+
+        # 1. validation spec table in config.py itself
+        spec = _string_collection(cfg.tree, SPEC_TABLE)
+        if spec is None:
+            out.append(cfg.finding(
+                self.name, None,
+                "config.py declares tpu_* params but has no %s table: "
+                "every tpu_* knob needs a declarative validation entry "
+                "(bounds, choices, or an explicit freeform kind)"
+                % SPEC_TABLE))
+            spec_names: Set[str] = set(fields)  # don't cascade
+        else:
+            spec_names = spec[0]
+            for name, node in sorted(fields.items()):
+                if name not in spec_names:
+                    out.append(cfg.finding(
+                        self.name, node,
+                        "%s has no %s entry: declare its validation "
+                        "(bounds/choices/kind) so check_param_conflict "
+                        "enforces it" % (name, SPEC_TABLE)))
+            for name in sorted(spec_names - set(fields)):
+                if name.startswith("tpu_"):
+                    out.append(cfg.finding(
+                        self.name, spec[1],
+                        "%s entry %r names no declared tpu_* field "
+                        "(stale spec row)" % (SPEC_TABLE, name)))
+
+        # 2. docs/Parameters.md regenerated with every param present
+        doc_path = os.path.join(os.path.dirname(pkg_dir), "docs",
+                                "Parameters.md")
+        try:
+            with open(doc_path, encoding="utf-8") as fh:
+                doc_text = fh.read()
+        except OSError:
+            doc_text = None
+            out.append(cfg.finding(
+                self.name, None,
+                "docs/Parameters.md not found next to the package — "
+                "regenerate it (python scripts/gen_params_doc.py); "
+                "tpu_* params must be documented"))
+        if doc_text is not None:
+            for name, node in sorted(fields.items()):
+                # word-bounded match: a param that is a PREFIX of
+                # another documented param (tpu_predict_quantize vs
+                # ..._tol) must still be flagged when its own row is
+                # missing
+                if not re.search(r"(?<![\w])%s(?![\w])"
+                                 % re.escape(name), doc_text):
+                    out.append(cfg.finding(
+                        self.name, node,
+                        "%s is not documented in docs/Parameters.md — "
+                        "regenerate it (python scripts/"
+                        "gen_params_doc.py)" % name))
+
+        # 3. fingerprint classification in sibling checkpoint.py
+        ckpt = next((f for f in files
+                     if os.path.dirname(f.path) == pkg_dir
+                     and os.path.basename(f.path) == "checkpoint.py"
+                     and f.tree is not None), None)
+        if ckpt is None:
+            out.append(cfg.finding(
+                self.name, None,
+                "no checkpoint.py alongside config.py in the scanned "
+                "set: tpu_* params cannot be fingerprint-classified"))
+            return out
+        excl = _string_collection(ckpt.tree, EXCLUDE_SET)
+        incl = _string_collection(ckpt.tree, INCLUDE_SET)
+        excl_names = excl[0] if excl else set()
+        incl_names = incl[0] if incl else set()
+        if excl is None:
+            out.append(ckpt.finding(
+                self.name, None,
+                "checkpoint.py has no %s set" % EXCLUDE_SET))
+        if incl is None:
+            out.append(ckpt.finding(
+                self.name, None,
+                "checkpoint.py has no %s classification (params that "
+                "deliberately participate in the resume fingerprint)"
+                % INCLUDE_SET))
+        for name, node in sorted(fields.items()):
+            in_e, in_i = name in excl_names, name in incl_names
+            if in_e and in_i:
+                out.append(cfg.finding(
+                    self.name, node,
+                    "%s is classified BOTH fingerprint-included and "
+                    "excluded in checkpoint.py — pick one" % name))
+            elif not in_e and not in_i and excl is not None \
+                    and incl is not None:
+                out.append(cfg.finding(
+                    self.name, node,
+                    "%s has no checkpoint-fingerprint classification: "
+                    "add it to %s (resume may legitimately differ) or "
+                    "%s (mismatch must refuse resume) in checkpoint.py"
+                    % (name, EXCLUDE_SET, INCLUDE_SET)))
+        for name in sorted((excl_names | incl_names) - set(fields)):
+            if name.startswith("tpu_") and name not in fields:
+                node = (excl[1] if excl and name in excl_names
+                        else incl[1] if incl else None)
+                out.append(ckpt.finding(
+                    self.name, node,
+                    "fingerprint classification names %r but config.py "
+                    "declares no such tpu_* field (stale entry)" % name))
+        return out

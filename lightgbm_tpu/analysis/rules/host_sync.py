@@ -1,0 +1,122 @@
+"""Rule `traced-host-sync`: host synchronization on traced values.
+
+The retrace/transfer hazard class the telemetry compile observer
+(PR 7) can only catch AT RUNTIME, moved to the source level: inside a
+jitted pass function, forcing a traced value to a Python scalar either
+fails outright under trace (`.item()`, `float()`, `bool()`,
+`np.asarray`) or — worse — silently works on concrete values in
+op-by-op debugging and then breaks or retraces in production. Implicit
+`if array:` truthiness has the same failure mode and additionally
+makes Python control flow depend on device data.
+
+Scope (documented, pinned by fixtures):
+
+- traced contexts are classified by astutil.ModuleIndex: jit/shard_map
+  decorated or wrapped functions, their lexically nested helpers, and
+  module-local callees; ops/predict.py's `predict_forest_*` kernels
+  (the serving dispatch path's compute, jitted via gbdt._forest_jit's
+  getattr) are known-traced by configuration.
+- `.item()` and `jax.device_get` / `np.asarray` / `np.array` /
+  `float|int|bool` host conversions are flagged when applied to a bare
+  parameter of a DIRECTLY-traced function that is not listed in its
+  `static_argnames` (static params are Python values — converting them
+  at trace time is legitimate constant folding, which is why derived
+  locals are out of scope for the conversions: too many false constants).
+- `.item()` is additionally flagged anywhere in a traced context — on
+  any expression: there is no legitimate trace-time `.item()`.
+- `if`/`while` on the BARE truthiness of a non-static parameter of a
+  directly-traced function (`if mask:`) is flagged; `is None` /
+  comparison tests stay legal (trace-time Python checks on optional
+  arguments are idiomatic, e.g. grow_tree's `n_valid is None`).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from ..core import Finding, Rule, SourceFile
+from .. import astutil
+from ..astutil import ModuleIndex, call_target
+
+from .collectives import KNOWN_TRACED
+
+_CONVERTERS = {"float", "int", "bool"}
+_HOST_FETCHERS = {"asarray", "array", "device_get"}
+
+
+class TracedHostSyncRule(Rule):
+    name = "traced-host-sync"
+    description = ("host sync on a traced value inside a jitted pass "
+                   "function (.item()/float()/np.asarray/if-array): "
+                   "trace failure or silent retrace/transfer hazard")
+
+    def check_file(self, src: SourceFile) -> Iterable[Finding]:
+        out: List[Finding] = []
+        idx = ModuleIndex(src.tree, src.display_path,
+                          known_traced=KNOWN_TRACED)
+        traced = idx.traced_functions()
+        if not traced:
+            return out
+
+        for fn in idx.functions:
+            if fn not in traced:
+                continue
+            directly = idx.directly_traced(fn)
+            params = idx.traced_params(fn) if directly else set()
+            # shallow walk: nested defs are visited as their own traced
+            # functions with their own parameter sets
+            for node in astutil.walk_shallow(fn):
+                if isinstance(node, ast.Call):
+                    out.extend(self._check_call(src, idx, node, params))
+                elif isinstance(node, (ast.If, ast.While)) and directly:
+                    test = node.test
+                    neg = isinstance(test, ast.UnaryOp) and \
+                        isinstance(test.op, ast.Not)
+                    probe = test.operand if neg else test
+                    if isinstance(probe, ast.Name) and probe.id in params:
+                        out.append(src.finding(
+                            self.name, test,
+                            "implicit truthiness of traced parameter "
+                            "%r in a jitted function: Python control "
+                            "flow on device data fails under trace "
+                            "(use jnp.where / lax.cond, or mark the "
+                            "argument static)" % probe.id))
+        return out
+
+    def _check_call(self, src: SourceFile, idx: ModuleIndex,
+                    node: ast.Call, params) -> List[Finding]:
+        out: List[Finding] = []
+        # x.item() — no legitimate trace-time use
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "item" and not node.args:
+            out.append(src.finding(
+                self.name, node,
+                ".item() inside a traced context forces a device->host "
+                "sync and fails under jit; return the array and fetch "
+                "it at the dispatch layer"))
+            return out
+        target = call_target(node, idx.imports)
+        if target is None or not node.args:
+            return out
+        arg0 = node.args[0]
+        if not (isinstance(arg0, ast.Name) and arg0.id in params):
+            return out
+        parts = target.split(".")
+        if target in _CONVERTERS:
+            out.append(src.finding(
+                self.name, node,
+                "%s(%s) on a traced parameter of a jitted function: "
+                "concretization fails under trace (jnp ops keep it on "
+                "device; static_argnames makes it a Python value)"
+                % (target, arg0.id)))
+        elif parts[-1] in _HOST_FETCHERS and \
+                (parts[0] in ("numpy", "onp")
+                 or target == "jax.device_get"):
+            # jax.numpy.asarray/array are DEVICE ops and legal under
+            # trace; only real numpy (host) and device_get sync
+            out.append(src.finding(
+                self.name, node,
+                "%s on traced parameter %r inside a jitted function "
+                "forces a host transfer (use jnp.asarray, or hoist the "
+                "conversion to the dispatch layer)" % (target, arg0.id)))
+        return out

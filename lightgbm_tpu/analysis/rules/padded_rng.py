@@ -1,0 +1,86 @@
+"""Rule `padded-rng`: prefix-unstable RNG draws over padded dimensions.
+
+The PR 11 incident class: threefry is NOT prefix-stable across output
+shapes, so `jax.random.uniform(key, (n_pad,))[:n]` differs from
+`jax.random.uniform(key, (n,))`. Because the pad width is a function of
+the DEVICE COUNT, a draw shaped by a padded dimension silently ties the
+sampled values (bagging masks, GOSS keep-sets) to the world size and
+breaks cross-world-size training bit-identity — exactly the latent
+bagging/GOSS bug PR 11 shipped and later had to excavate.
+
+The invariant: draw over the REAL extent `(n,)` and pad the RESULT
+(`jnp.pad(jax.random.uniform(key, (n,)), (0, n_pad - n))`), making the
+sample a pure function of (seed, iteration, n) at any world size.
+
+Detection: a call to a `jax.random` sampling function whose ARGUMENT
+expressions mention a padded-dimension identifier — any name or
+attribute with a `pad`/`padded`/`bucket` component (`n_pad`,
+`rows_padded`, `bucket_rows`, ...). Padding the draw's RESULT is fine:
+the padded identifier then sits outside the sampling call's own
+argument list.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from ..core import Finding, Rule, SourceFile
+from ..astutil import ImportTable, call_target, identifiers_in
+
+# value-producing samplers (key plumbing like split/fold_in is exempt:
+# keys are shape-independent)
+SAMPLING_FNS = {
+    "uniform", "normal", "bernoulli", "randint", "bits", "exponential",
+    "gamma", "beta", "cauchy", "dirichlet", "gumbel", "laplace",
+    "logistic", "maxwell", "multivariate_normal", "pareto", "poisson",
+    "rademacher", "rayleigh", "t", "truncated_normal", "weibull_min",
+    "categorical", "choice", "permutation", "shuffle", "binomial",
+    "geometric", "loggamma", "orthogonal", "triangular", "wald",
+}
+
+_PAD_COMPONENTS = {"pad", "padded", "npad", "bucket", "bucketed"}
+
+
+def _padded_identifier(name: str) -> bool:
+    return any(part in _PAD_COMPONENTS
+               for part in name.lower().split("_") if part)
+
+
+class PaddedRngRule(Rule):
+    name = "padded-rng"
+    description = ("jax.random draw shaped by a padded dimension "
+                   "(device-count-dependent sample; draw (n,) and pad "
+                   "the result)")
+
+    def check_file(self, src: SourceFile) -> Iterable[Finding]:
+        out: List[Finding] = []
+        imports = ImportTable(src.tree)
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            target = call_target(node, imports)
+            if target is None:
+                continue
+            parts = target.split(".")
+            if parts[-1] not in SAMPLING_FNS:
+                continue
+            # must actually be jax.random.<fn> (possibly via alias /
+            # from-import), not numpy.random or a local helper
+            if "jax" not in parts or "random" not in parts:
+                continue
+            offenders = sorted(
+                ident
+                for arg in list(node.args) + [kw.value
+                                              for kw in node.keywords]
+                for ident in identifiers_in(arg)
+                if _padded_identifier(ident))
+            if offenders:
+                out.append(src.finding(
+                    self.name, node,
+                    "RNG draw %s is shaped by padded dimension(s) %s — "
+                    "threefry is not prefix-stable across shapes, so "
+                    "the sample depends on the device count; draw the "
+                    "real extent (n,) and pad the result (the PR 11 "
+                    "bagging/GOSS bug class)"
+                    % (parts[-1], ", ".join(offenders))))
+        return out

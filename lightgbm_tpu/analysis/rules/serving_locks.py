@@ -1,0 +1,183 @@
+"""Rules `serving-lock` and `future-guard`: the PR 12 review-fix classes.
+
+`serving-lock` — check-then-act races on shared serving state. PR 12's
+review found K racing `predict()` calls could exceed the in-flight cap
+by K-1 because the check and the increment took the lock separately.
+The source-level invariant: inside `lightgbm_tpu/serving/`, any
+READ-MODIFY-WRITE of shared instance state — an augmented assignment on
+an attribute (`self.inflight += 1`, `entry.requests += 1`) or a
+subscript of an attribute (`self.counts[k] += 1`), or a plain
+assignment whose right-hand side reads the attribute it writes — must
+execute under a lock `with` (`with self._lock:` / `with self._cv:`),
+either lexically or inside a function whose every in-module call site
+holds the lock. The same applies to an `if` that tests an attribute
+and writes that attribute in its body (the literal check-then-act
+shape). `__init__`/`__new__` are exempt: no concurrent reader can hold
+the object yet.
+
+`future-guard` — future resolution without the InvalidStateError
+guard. A client may `cancel()` a queued future (the request-timeout
+pattern) or a shutdown sweep may have failed it already; a bare
+`set_result`/`set_exception` then RAISES and kills the batcher thread
+that every other queued request depends on. Resolution must go through
+a try/except InvalidStateError (the predictor's `_resolve`/`_fail`
+helpers).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional
+
+from ..core import Finding, Rule, SourceFile
+from .. import astutil
+from ..astutil import ModuleIndex
+
+SERVING_SEGMENT = "/serving/"
+_INIT_EXEMPT = {"__init__", "__new__", "__init_subclass__"}
+
+
+def _in_scope(src: SourceFile) -> bool:
+    return SERVING_SEGMENT in "/" + src.display_path
+
+
+def _attr_chain(node: ast.AST) -> Optional[str]:
+    """'self.counts' for Attribute, 'self.counts[]' for Subscript of an
+    attribute — a stable identity for the shared-state slot."""
+    if isinstance(node, ast.Subscript):
+        base = astutil.dotted_name(node.value)
+        return base + "[]" if base else None
+    return astutil.dotted_name(node)
+
+
+def _is_shared(chain: Optional[str]) -> bool:
+    """Only attribute state can be shared across threads; bare locals
+    never are."""
+    return chain is not None and "." in chain
+
+
+class ServingLockRule(Rule):
+    name = "serving-lock"
+    description = ("check-then-act / read-modify-write on shared "
+                   "serving state outside a lock hold (racy admission "
+                   "counters, the PR 12 cap-overrun class)")
+
+    def check_file(self, src: SourceFile) -> Iterable[Finding]:
+        out: List[Finding] = []
+        if not _in_scope(src):
+            return out
+        idx = ModuleIndex(src.tree, src.display_path)
+        covered = idx.covered_functions(astutil.lock_guard)
+
+        def guarded(node: ast.AST) -> bool:
+            return idx.guarded(node, astutil.lock_guard, covered)
+
+        def exempt(node: ast.AST) -> bool:
+            encs = astutil.enclosing_functions(node, idx.parents)
+            return bool(encs) and encs[0].name in _INIT_EXEMPT
+
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.AugAssign):
+                chain = _attr_chain(node.target)
+                if _is_shared(chain) and not exempt(node) \
+                        and not guarded(node):
+                    out.append(src.finding(
+                        self.name, node,
+                        "read-modify-write of shared %s outside a lock "
+                        "hold: concurrent requests lose updates or "
+                        "overrun caps (take self._lock around check "
+                        "AND act)" % chain))
+            elif isinstance(node, ast.Assign):
+                for target in node.targets:
+                    chain = _attr_chain(target)
+                    if not _is_shared(chain) or exempt(node) \
+                            or guarded(node):
+                        continue
+                    reads = {_attr_chain(n) for n in ast.walk(node.value)
+                             if isinstance(n, (ast.Attribute,
+                                               ast.Subscript))}
+                    if chain in reads:
+                        out.append(src.finding(
+                            self.name, node,
+                            "read-modify-write of shared %s outside a "
+                            "lock hold (value reads the slot it "
+                            "writes)" % chain))
+            elif isinstance(node, ast.If):
+                if exempt(node) or guarded(node):
+                    continue
+                tested = {
+                    _attr_chain(n) for n in ast.walk(node.test)
+                    if isinstance(n, (ast.Attribute, ast.Subscript))}
+                tested = {t for t in tested if _is_shared(t)}
+                if not tested:
+                    continue
+                written = set()
+                for stmt in node.body:
+                    for n in ast.walk(stmt):
+                        if isinstance(n, ast.AugAssign):
+                            written.add(_attr_chain(n.target))
+                        elif isinstance(n, ast.Assign):
+                            written.update(_attr_chain(t)
+                                           for t in n.targets)
+                hits = sorted(x for x in tested & written if x)
+                if hits:
+                    out.append(src.finding(
+                        self.name, node,
+                        "check-then-act on shared %s outside a lock "
+                        "hold: the state can change between the test "
+                        "and the write" % ", ".join(hits)))
+        return out
+
+
+class FutureGuardRule(Rule):
+    name = "future-guard"
+    description = ("fut.set_result/set_exception without the "
+                   "InvalidStateError guard: a raced cancel()/shutdown "
+                   "sweep kills the batcher thread")
+
+    def check_file(self, src: SourceFile) -> Iterable[Finding]:
+        out: List[Finding] = []
+        if not _in_scope(src):
+            return out
+        idx = ModuleIndex(src.tree, src.display_path)
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not isinstance(node.func, ast.Attribute):
+                continue
+            if node.func.attr not in ("set_result", "set_exception"):
+                continue
+            if self._guarded(node, idx):
+                continue
+            out.append(src.finding(
+                self.name, node,
+                "%s() without an InvalidStateError guard: a future the "
+                "client cancel()ed (or a shutdown sweep already "
+                "failed) raises here and kills the resolving thread — "
+                "use the _resolve/_fail helpers or wrap in "
+                "try/except InvalidStateError" % node.func.attr))
+        return out
+
+    @staticmethod
+    def _guarded(node: ast.AST, idx: ModuleIndex) -> bool:
+        """Lexically inside the BODY of a try whose handlers name
+        InvalidStateError (alone or in a tuple) — a resolution in the
+        handler/else/finally suites is not protected by it."""
+        child = node
+        cur = idx.parents.get(node)
+        while cur is not None and not isinstance(cur, astutil.FuncNode):
+            if isinstance(cur, ast.Try) and child in cur.body:
+                handler_types = []
+                for handler in cur.handlers:
+                    if handler.type is None:
+                        continue
+                    if isinstance(handler.type, ast.Tuple):
+                        handler_types.extend(handler.type.elts)
+                    else:
+                        handler_types.append(handler.type)
+                names = {astutil.dotted_name(t) for t in handler_types}
+                if any(n and n.split(".")[-1] == "InvalidStateError"
+                       for n in names):
+                    return True
+            child = cur
+            cur = idx.parents.get(cur)
+        return False

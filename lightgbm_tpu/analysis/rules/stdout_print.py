@@ -1,0 +1,57 @@
+"""Rule `stdout-print`: stdout hygiene inside the package.
+
+Migrated from the ad-hoc AST guard that used to live in
+tests/conftest.py's `pytest_sessionstart` (PR 7): no `lightgbm_tpu/`
+module may write to stdout via bare `print()` — everything routes
+through `log` (stderr / registered callback) or telemetry sinks, so
+CLI pipelines and the bench driver's JSON-per-line stdout contract
+stay parseable.
+
+Same semantics as the conftest gate, now with pragma/baseline support:
+
+- allowlist: `cli.py` and `__main__.py` — the CLI entry points, whose
+  stdout IS the product (this covers graftlint's own CLI too);
+- prints explicitly directed at `sys.stderr` are fine;
+- scope: files under a `lightgbm_tpu` package directory only; scripts
+  and tests own their stdout contracts.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from ..core import Finding, Rule, SourceFile
+
+ALLOWED_BASENAMES = {"cli.py", "__main__.py"}
+PACKAGE_SEGMENT = "lightgbm_tpu"
+
+
+class StdoutPrintRule(Rule):
+    name = "stdout-print"
+    description = ("bare print() to stdout inside lightgbm_tpu/ "
+                   "(route through log/telemetry; cli.py and "
+                   "__main__.py are allowlisted)")
+
+    def check_file(self, src: SourceFile) -> Iterable[Finding]:
+        out: List[Finding] = []
+        parts = src.display_path.split("/")
+        if PACKAGE_SEGMENT not in parts[:-1]:
+            return out
+        if parts[-1] in ALLOWED_BASENAMES:
+            return out
+        for node in ast.walk(src.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "print"):
+                continue
+            file_kw = next((kw.value for kw in node.keywords
+                            if kw.arg == "file"), None)
+            if isinstance(file_kw, ast.Attribute) \
+                    and file_kw.attr == "stderr":
+                continue
+            out.append(src.finding(
+                self.name, node,
+                "bare print() to stdout inside lightgbm_tpu/: route "
+                "through log (stderr) or telemetry sinks so the CLI / "
+                "bench JSON stdout contracts stay parseable"))
+        return out

@@ -379,10 +379,13 @@ class GBDT:
         n_pad = layout.n_pad
         if nproc > 1:
             # every process must contribute an equal-sized row block to
-            # the global array: pad all shards to the largest
+            # the global array: pad all shards to the largest. Deadline-
+            # guarded like every other host collective: a peer that died
+            # before init must fail this rank with rc 113, not hang it
             from jax.experimental import multihost_utils
-            n_pad = int(multihost_utils.process_allgather(
-                jnp.asarray(np.int64(n_pad))).max())
+            with watchdog.deadline("gbdt.init.pad_sync"):
+                n_pad = int(multihost_utils.process_allgather(
+                    jnp.asarray(np.int64(n_pad))).max())
         self._n = n
         self._n_pad = n_pad
 
@@ -450,8 +453,9 @@ class GBDT:
                     a = np.asarray(arr, np.float64)
                     hi = a.astype(np.float32)
                     lo = (a - hi.astype(np.float64)).astype(np.float32)
-                    g = multihost_utils.process_allgather(
-                        jnp.stack([jnp.asarray(hi), jnp.asarray(lo)]))
+                    with watchdog.deadline("gbdt.boost_from_average"):
+                        g = multihost_utils.process_allgather(
+                            jnp.stack([jnp.asarray(hi), jnp.asarray(lo)]))
                     g = np.asarray(g, np.float64)  # [P, 2, ...]
                     return (g[:, 0] + g[:, 1]).sum(axis=0)
 

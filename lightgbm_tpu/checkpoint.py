@@ -82,10 +82,44 @@ _FINGERPRINT_EXCLUDE = {
     "tpu_serving_deadline_ms", "tpu_serving_model_qps",
     "tpu_serving_breaker_failures", "tpu_serving_breaker_reset_s",
     "tpu_serving_budget_mb", "tpu_compile_cache_dir",
+    # predict-path layout/batching knobs (ISSUE 13 config-hygiene
+    # sweep): bucket ladders, micro-batching, warmup, and the quantized
+    # SERVING stacks change how predictions are dispatched, never how
+    # trees are grown (quantized layouts are build-time derived from
+    # the exact f32 forest; split decisions stay bit-exact) — a resumed
+    # run may reshape its serving tier freely
+    "tpu_predict_cache", "tpu_predict_bucket_min", "tpu_predict_chunk",
+    "tpu_predict_pipeline", "tpu_predict_quantize",
+    "tpu_predict_quantize_tol", "tpu_predict_warmup_rows",
+    "tpu_predict_micro_batch", "tpu_predict_micro_batch_window_ms",
     "output_model", "output_result", "input_model", "convert_model",
     "config_file", "machine_list_file", "snapshot_freq", "verbose",
     "metric_freq", "num_iterations", "num_threads", "task",
 }
+
+# tpu_* params that DELIBERATELY participate in the fingerprint: each
+# one changes the training trajectory (numerics, grow order, or failure
+# behavior), so resume must refuse a snapshot taken under a different
+# value. `config_fingerprint` hashes everything not excluded — this set
+# is the EXPLICIT record of that decision for the tpu_* namespace, and
+# graftlint's config-hygiene rule cross-checks it against config.py:
+# every tpu_* field must appear in exactly one of the two sets, so a
+# new knob cannot ship with its resume semantics undecided.
+_FINGERPRINT_INCLUDED = {
+    # histogram numerics/order: precision, bf16 accumulation, batched
+    # grow order, compaction and subtraction reshape the f32 summation
+    # tree (subtract/compact are bit-identical TODAY, but that identity
+    # is a test-enforced property of the current kernels, not a
+    # contract — keep them fingerprinted so resume never blends paths)
+    "tpu_hist_chunk", "tpu_double_precision", "tpu_batch_k",
+    "tpu_hist_bf16", "tpu_hist_subtract", "tpu_hist_compact",
+    "tpu_compact_threshold", "tpu_hist_pallas",
+    # nonfinite guard aborts the trajectory instead of continuing it
+    "tpu_guard_nonfinite",
+}
+
+assert not (_FINGERPRINT_INCLUDED & _FINGERPRINT_EXCLUDE), \
+    "a tpu_* param cannot be both fingerprint-included and excluded"
 
 
 class CheckpointError(log.LightGBMError):

@@ -415,6 +415,71 @@ class BoostingConfig:
     tpu_guard_nonfinite: bool = True
 
 
+# ---------------------------------------------------------------------------
+# tpu_* validation spec — machine-checked by graftlint's config-hygiene
+# rule: EVERY tpu_* dataclass field above must have exactly one entry
+# here (and appear in docs/Parameters.md and in checkpoint.py's
+# fingerprint classification). check_param_conflict applies the table
+# generically, so no tpu_* knob can ship without a validation decision.
+# Forms:
+#   "bool" / "path" / "str"        — type-validated by the parse pipeline
+#   ("int"|"float", lo, hi)        — inclusive bounds, None = unbounded
+#   ("float>", lo)                 — exclusive lower bound
+#   ("choice", opt, ...)           — lowercased membership
+# ---------------------------------------------------------------------------
+TPU_PARAM_SPEC = {
+    # checkpointing / elasticity
+    "tpu_checkpoint_dir": "path",
+    "tpu_checkpoint_interval": ("int", 1, None),
+    "tpu_checkpoint_keep": ("int", 1, None),
+    "tpu_elastic_resume": "bool",
+    # telemetry
+    "tpu_telemetry_dir": "path",
+    "tpu_telemetry": "bool",
+    "tpu_telemetry_prometheus": "bool",
+    # ingest
+    "tpu_ingest": "bool",
+    "tpu_ingest_chunk_rows": ("int", 1, None),
+    "tpu_ingest_device_shards": "bool",
+    # predict / serving tier
+    "tpu_predict_cache": "bool",
+    "tpu_predict_bucket_min": ("int", None, None),   # <= 0 disables
+    "tpu_predict_chunk": ("int", 0, None),
+    "tpu_predict_pipeline": "bool",
+    # must mirror serving/forest.QUANTIZE_MODES (kept literal so the
+    # table stays import-free and AST-readable)
+    "tpu_predict_quantize": ("choice", "none", "f16", "int8"),
+    "tpu_predict_quantize_tol": ("float>", 0.0),
+    "tpu_predict_warmup_rows": ("int", 0, None),
+    "tpu_predict_micro_batch": ("int", 0, None),
+    "tpu_predict_micro_batch_window_ms": ("float", 0.0, None),
+    "tpu_serving_budget_mb": ("float", 0.0, None),
+    "tpu_serving_max_queue": ("int", 0, None),
+    "tpu_serving_max_inflight": ("int", 0, None),
+    "tpu_serving_deadline_ms": ("float", 0.0, None),
+    "tpu_serving_model_qps": ("float", 0.0, None),
+    "tpu_serving_breaker_failures": ("int", 0, None),
+    "tpu_serving_breaker_reset_s": ("float", 0.0, None),
+    "tpu_compile_cache_dir": "path",
+    # tree / histogram schedule
+    "tpu_hist_chunk": ("int", 1, None),
+    "tpu_double_precision": "bool",
+    "tpu_batch_k": ("int", 1, None),
+    "tpu_hist_bf16": "bool",
+    "tpu_hist_subtract": "bool",
+    "tpu_hist_compact": "bool",
+    "tpu_compact_threshold": ("float", None, None),  # <= 0 disables
+    "tpu_hist_reduce": ("choice", "scatter", "allreduce"),
+    "tpu_hist_pallas": "bool",                       # retired, warns
+    # boosting
+    "tpu_guard_nonfinite": "bool",
+    # network / watchdog
+    "tpu_collective_timeout_s": ("float", 0.0, None),
+    "tpu_heartbeat_dir": "path",
+    "tpu_heartbeat_lease_s": ("float", 0.0, None),
+}
+
+
 _BOOL_TRUE = {"true", "1", "yes", "y", "t", "+"}
 _BOOL_FALSE = {"false", "0", "no", "n", "f", "-"}
 
@@ -552,29 +617,7 @@ class Config:
             self.is_parallel = False
         if self.is_parallel and self.tree_learner in ("data", "voting"):
             self.is_parallel_find_bin = True
-        if self.tree.tpu_hist_reduce not in ("scatter", "allreduce"):
-            log.fatal("tpu_hist_reduce must be 'scatter' or 'allreduce' "
-                      "(got %r)" % (self.tree.tpu_hist_reduce,))
-        from .serving.forest import QUANTIZE_MODES
-        self.io.tpu_predict_quantize = \
-            str(self.io.tpu_predict_quantize).lower()
-        if self.io.tpu_predict_quantize not in QUANTIZE_MODES:
-            log.fatal("tpu_predict_quantize must be one of %s (got %r)"
-                      % ("/".join(QUANTIZE_MODES),
-                         self.io.tpu_predict_quantize))
-        if self.io.tpu_predict_quantize_tol <= 0:
-            log.fatal("tpu_predict_quantize_tol must be > 0 (got %r)"
-                      % (self.io.tpu_predict_quantize_tol,))
-        if self.io.tpu_serving_budget_mb < 0:
-            log.fatal("tpu_serving_budget_mb must be >= 0 (got %r)"
-                      % (self.io.tpu_serving_budget_mb,))
-        for p in ("tpu_serving_max_queue", "tpu_serving_max_inflight",
-                  "tpu_serving_deadline_ms", "tpu_serving_model_qps",
-                  "tpu_serving_breaker_failures",
-                  "tpu_serving_breaker_reset_s"):
-            if getattr(self.io, p) < 0:
-                log.fatal("%s must be >= 0 (got %r)"
-                          % (p, getattr(self.io, p)))
+        self._validate_tpu_params()
         if self.tree.histogram_pool_size >= 0 and self.tree_learner != "serial":
             log.warning("histogram_pool_size is only supported by serial "
                         "tree learner; ignoring")
@@ -584,6 +627,43 @@ class Config:
             self.objective_config.label_gain = [float((1 << i) - 1) for i in range(31)]
         if self.tree.num_leaves < 2:
             log.fatal("num_leaves must be >= 2")
+
+    def _validate_tpu_params(self) -> None:
+        """Apply TPU_PARAM_SPEC to every tpu_* field generically (the
+        config-hygiene static-analysis rule keeps the table complete;
+        an unspecced field is fatal here too, so the invariant holds
+        even when the lint does not run)."""
+        for sec in (self.io, self.tree, self.boosting,
+                    self.objective_config, self.metric, self.network):
+            for f in dataclasses.fields(sec):
+                if not f.name.startswith("tpu_"):
+                    continue
+                spec = TPU_PARAM_SPEC.get(f.name)
+                if spec is None:
+                    log.fatal("%s has no TPU_PARAM_SPEC entry (declare "
+                              "its validation in config.py)" % f.name)
+                if isinstance(spec, str):
+                    continue  # bool/path/str: typed by the parse pipeline
+                value = getattr(sec, f.name)
+                kind = spec[0]
+                if kind == "choice":
+                    v = str(value).lower()
+                    setattr(sec, f.name, v)
+                    if v not in spec[1:]:
+                        log.fatal("%s must be one of %s (got %r)"
+                                  % (f.name, "/".join(spec[1:]), value))
+                elif kind == "float>":
+                    if value <= spec[1]:
+                        log.fatal("%s must be > %s (got %r)"
+                                  % (f.name, spec[1], value))
+                else:  # ("int"|"float", lo, hi)
+                    lo, hi = spec[1], spec[2]
+                    if lo is not None and value < lo:
+                        log.fatal("%s must be >= %s (got %r)"
+                                  % (f.name, lo, value))
+                    if hi is not None and value > hi:
+                        log.fatal("%s must be <= %s (got %r)"
+                                  % (f.name, hi, value))
 
 
 def key_alias_transform(params: Dict[str, Any]) -> Dict[str, Any]:

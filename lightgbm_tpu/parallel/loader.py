@@ -279,9 +279,14 @@ def _prepartition_bin_sample(path: str, has_header: bool, chunk_rows: int,
     from jax.experimental import multihost_utils
 
     from .multihost import allgather_bytes
+    from .watchdog import deadline
 
-    counts = np.asarray(multihost_utils.process_allgather(
-        jnp.asarray(np.int64(local_rows)))).reshape(-1)
+    # the row-count exchange is a host collective like the sample merge
+    # below (which self-guards inside allgather_bytes): a rank that died
+    # mid-load must fail its peers with rc 113, not block them here
+    with deadline("loader.partition_counts"):
+        counts = np.asarray(multihost_utils.process_allgather(
+            jnp.asarray(np.int64(local_rows)))).reshape(-1)
     blob, total = _partition_sample_slice(
         path, has_header, chunk_rows, counts, jax.process_index(),
         sample_cnt, seed)

@@ -315,10 +315,10 @@ class Predictor:
         if self._micro_batch <= 0:
             self.admission.admit_sync(deadline_abs)
             try:
-                fut.set_result(self._predict_timed(
+                _resolve(fut, self._predict_timed(
                     arr.reshape(1, -1), deadline_abs=deadline_abs)[0])
             except Exception as exc:  # surface through the future
-                fut.set_exception(exc)
+                _fail(fut, exc)
             finally:
                 self.admission.release_sync()
             return fut
@@ -440,9 +440,18 @@ class Predictor:
         with self._cv:
             self._closed = True
             self._cv.notify_all()
-        if self._batcher is not None:
-            self._batcher.join(timeout=timeout)
-            self._batcher = None
+            # read (don't clear) the batcher under the lock: EVERY
+            # racing close() must wait out the same drain window —
+            # Thread.join is multi-caller-safe, whereas clearing here
+            # would let a second closer skip straight to the sweep and
+            # fail futures the batcher was actively draining. Join
+            # OUTSIDE the lock — the batcher takes it to drain
+            batcher = self._batcher
+        if batcher is not None:
+            batcher.join(timeout=timeout)
+            with self._cv:
+                if self._batcher is batcher:
+                    self._batcher = None
         # shutdown sweep: after the drain window nothing may stay
         # pending forever — a leaked Future is an indefinitely blocked
         # caller, the one outcome the overload contract forbids

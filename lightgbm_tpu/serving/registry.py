@@ -408,7 +408,8 @@ class ModelRegistry:
                 continue
             total -= freed
             evicted += 1
-            self.stats_counts["evictions"] += 1
+            with self._lock:  # shared counter: racing publishes also bump it
+                self.stats_counts["evictions"] += 1
             telemetry.counter_add("serving/registry_evictions", 1,
                                   labels={"model": name})
             log.debug("Registry evicted %s stacks (%d bytes; total %d > "

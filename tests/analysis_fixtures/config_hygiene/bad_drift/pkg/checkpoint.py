@@ -1,0 +1,8 @@
+"""POSITIVE fixture: fingerprint classification with a stale entry and
+a double classification (tpu_both), missing tpu_unclassified."""
+
+_FINGERPRINT_EXCLUDE = {
+    "tpu_alpha", "tpu_missing_spec", "tpu_undocumented", "tpu_both",
+    "tpu_stale_entry",  # names no declared field
+}
+_FINGERPRINT_INCLUDED = {"tpu_both"}

@@ -1,0 +1,22 @@
+"""POSITIVE fixture: every leg of the tpu_* triangle drifted at once —
+a field with no validation spec, a stale spec row, an undocumented
+field, an unclassified field, and a double-classified field."""
+from dataclasses import dataclass
+
+
+@dataclass
+class IOConfig:
+    tpu_alpha: int = 1          # consistent everywhere
+    tpu_missing_spec: int = 0   # no TPU_PARAM_SPEC row
+    tpu_undocumented: int = 0   # absent from docs/Parameters.md
+    tpu_unclassified: int = 0   # in neither fingerprint set
+    tpu_both: int = 0           # in BOTH fingerprint sets
+
+
+TPU_PARAM_SPEC = {
+    "tpu_alpha": ("int", 1, None),
+    "tpu_undocumented": "bool",
+    "tpu_unclassified": "bool",
+    "tpu_both": "bool",
+    "tpu_stale_row": "bool",    # names no declared field
+}

@@ -1,0 +1,4 @@
+"""NEGATIVE fixture: every tpu_* field classified exactly once."""
+
+_FINGERPRINT_EXCLUDE = {"tpu_beta"}
+_FINGERPRINT_INCLUDED = {"tpu_alpha"}

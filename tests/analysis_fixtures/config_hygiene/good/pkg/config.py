@@ -1,0 +1,16 @@
+"""NEGATIVE fixture: the tpu_* triangle fully consistent — every field
+has a validation spec row, appears in docs/Parameters.md, and is
+classified in exactly one fingerprint set in the sibling checkpoint.py."""
+from dataclasses import dataclass
+
+
+@dataclass
+class IOConfig:
+    tpu_alpha: int = 1
+    tpu_beta: bool = False
+
+
+TPU_PARAM_SPEC = {
+    "tpu_alpha": ("int", 1, None),
+    "tpu_beta": "bool",
+}

@@ -1,0 +1,11 @@
+"""POSITIVE fixture: bare future resolution — a future the client
+cancel()ed (or a shutdown sweep already failed) raises
+InvalidStateError here and kills the batcher thread every other
+queued request depends on."""
+
+
+def resolve_batch(futures, results, exc):
+    for fut, value in zip(futures, results):
+        fut.set_result(value)
+    if exc is not None:
+        futures[-1].set_exception(exc)

@@ -1,0 +1,18 @@
+"""NEGATIVE fixture: the predictor's _resolve/_fail idiom — resolution
+inside the body of a try whose handler names InvalidStateError (alone
+or in a tuple)."""
+from concurrent.futures import InvalidStateError
+
+
+def _resolve(fut, value):
+    try:
+        fut.set_result(value)
+    except InvalidStateError:
+        pass
+
+
+def _fail(fut, exc):
+    try:
+        fut.set_exception(exc)
+    except (InvalidStateError, RuntimeError):
+        pass

@@ -1,0 +1,11 @@
+"""POSITIVE fixture: aliased imports and keyword shape arguments still
+resolve to jax.random draws over padded dimensions."""
+from jax import random as jr
+
+
+def dropout_mask(key, rows_padded, rate):
+    return jr.bernoulli(key, rate, shape=(rows_padded,))
+
+
+def bucket_noise(key, bucket_rows):
+    return jr.normal(key, shape=(bucket_rows, 4))

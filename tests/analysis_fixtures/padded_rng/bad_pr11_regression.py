@@ -1,0 +1,18 @@
+"""POSITIVE fixture: the exact PR 11 bagging/GOSS bug pattern.
+
+The mask is drawn over the PADDED row count. threefry is not
+prefix-stable across output shapes, and the pad width is a function of
+the device count, so in-bag selection silently depends on the world
+size — the latent bug PR 11 shipped and later had to excavate.
+"""
+import jax
+
+
+def bagging_mask(key, n, n_pad, fraction):
+    mask = jax.random.uniform(key, (n_pad,)) < fraction
+    return mask
+
+
+def goss_keep_set(key, grad, n_pad, top_k):
+    order = jax.random.permutation(key, n_pad)
+    return order[:top_k]

@@ -1,0 +1,16 @@
+"""NEGATIVE fixture: the PR 11 fix — draw the REAL extent (n,) and pad
+the RESULT, so the sample is a pure function of (seed, iteration, n)
+at any world size. The padded identifier appears only outside the
+sampling call's own argument list."""
+import jax
+import jax.numpy as jnp
+
+
+def bagging_mask(key, n, n_pad, fraction):
+    mask = jax.random.uniform(key, (n,)) < fraction
+    return jnp.pad(mask, (0, n_pad - n))
+
+
+def split_keys(key, n_pad):
+    # key plumbing is shape-independent: fold_in/split are not draws
+    return jax.random.fold_in(key, n_pad)

@@ -1,0 +1,12 @@
+"""NEGATIVE fixture: numpy host RNG is not jax.random — prefix
+stability across shapes is not the hazard there, and the rule must not
+fire on np.random or on RandomState methods."""
+import numpy as np
+
+
+def host_noise(n_pad):
+    return np.random.uniform(size=n_pad)
+
+
+def state_noise(rng, rows_padded):
+    return rng.uniform(size=rows_padded)

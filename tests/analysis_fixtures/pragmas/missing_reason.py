@@ -1,0 +1,7 @@
+"""Pragma semantics fixture: a reasonless pragma suppresses NOTHING —
+the original finding stands AND the pragma is itself a finding."""
+import jax
+
+
+def mask(key, n_pad):
+    return jax.random.uniform(key, (n_pad,))  # graftlint: disable=padded-rng

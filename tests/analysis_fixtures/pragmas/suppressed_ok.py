@@ -1,0 +1,7 @@
+"""Pragma semantics fixture: a finding suppressed with a WRITTEN
+reason on the same line is recorded as a suppression, not a finding."""
+import jax
+
+
+def mask(key, n_pad):
+    return jax.random.uniform(key, (n_pad,))  # graftlint: disable=padded-rng  fixture: pins the suppression contract

@@ -1,0 +1,7 @@
+"""Pragma semantics fixture: naming a rule the engine does not know is
+itself a finding, so suppressions cannot rot silently when a rule is
+renamed."""
+
+
+def f():
+    return 1  # graftlint: disable=no-such-rule  the rule this aimed at was renamed away

@@ -1,0 +1,22 @@
+"""POSITIVE fixture: the PR 12 cap-overrun class — check-then-act and
+read-modify-write on shared admission state outside any lock hold, so
+K racing requests can exceed the cap by K-1 or lose updates."""
+
+
+class Admission:
+    def __init__(self):
+        self.inflight = 0
+        self.max_inflight = 4
+        self.counts = {}
+
+    def admit(self):
+        if self.inflight < self.max_inflight:
+            self.inflight += 1
+            return True
+        return False
+
+    def release(self):
+        self.inflight -= 1
+
+    def record(self, key):
+        self.counts[key] = self.counts[key] + 1

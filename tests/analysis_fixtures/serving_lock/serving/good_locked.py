@@ -1,0 +1,26 @@
+"""NEGATIVE fixture: the same counters under the lock — lexically,
+and through a helper whose every in-module call site holds it;
+__init__ writes are exempt (no concurrent reader holds the object
+yet)."""
+import threading
+
+
+class Admission:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.inflight = 0
+        self.max_inflight = 4
+
+    def admit(self):
+        with self._lock:
+            if self.inflight < self.max_inflight:
+                self.inflight += 1
+                return True
+            return False
+
+    def release(self):
+        with self._lock:
+            self._release_locked()
+
+    def _release_locked(self):
+        self.inflight -= 1

@@ -1,0 +1,6 @@
+"""POSITIVE fixture: bare print() to stdout inside a lightgbm_tpu
+package directory — breaks the CLI / bench JSON stdout contracts."""
+
+
+def report(msg):
+    print(msg)

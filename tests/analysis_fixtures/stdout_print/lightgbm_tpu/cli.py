@@ -1,0 +1,6 @@
+"""NEGATIVE fixture: cli.py is allowlisted — its stdout IS the
+product."""
+
+
+def main():
+    print("result line")

@@ -1,0 +1,7 @@
+"""NEGATIVE fixture: prints explicitly directed at sys.stderr are
+fine — that is where log output belongs."""
+import sys
+
+
+def report(msg):
+    print(msg, file=sys.stderr)

@@ -1,0 +1,18 @@
+"""POSITIVE fixture: concretizing traced parameters of a jitted
+function — float()/np.asarray fail under trace (or silently force a
+transfer), and implicit truthiness puts Python control flow on device
+data."""
+import jax
+import numpy as np
+
+
+@jax.jit
+def pass_fn(score, mask):
+    if mask:
+        return float(score)
+    return score
+
+
+@jax.jit
+def fetch(hist):
+    return np.asarray(hist)

@@ -1,0 +1,9 @@
+"""POSITIVE fixture: .item() inside a jitted pass function — there is
+no legitimate trace-time .item(); it forces a device->host sync and
+fails under jit."""
+import jax
+
+
+@jax.jit
+def best_gain(gains):
+    return gains.max().item()

@@ -1,0 +1,19 @@
+"""NEGATIVE fixture: the legal shapes — static_argnames params are
+Python values (converting them is constant folding), `is None` checks
+on optional args are idiomatic trace-time Python, jnp.asarray is a
+device op, and untraced host helpers may sync freely."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("k",))
+def topk_pass(scores, k, n_valid=None):
+    if n_valid is None:
+        k = int(k)
+    return jnp.asarray(scores)[:k]
+
+
+def host_summary(arr):
+    return float(arr.max())

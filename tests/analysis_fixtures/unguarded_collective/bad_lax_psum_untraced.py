@@ -1,0 +1,12 @@
+"""POSITIVE fixture: device collectives outside any traced context —
+jax.lax collectives only execute under a trace, and the host dispatch
+that runs them must itself be watchdog-armed."""
+import jax
+
+
+def merge_histograms(hist):
+    return jax.lax.psum(hist, axis_name="d")
+
+
+def scatter_merge(hist):
+    return jax.lax.psum_scatter(hist, axis_name="d", tiled=True)

@@ -1,0 +1,8 @@
+"""POSITIVE fixture: a raw host-level collective with no watchdog
+deadline — a dead peer blocks this rank forever (the PR 11 contract
+says every host collective must be armed)."""
+from jax.experimental import multihost_utils
+
+
+def sync_row_counts(local_rows):
+    return multihost_utils.process_allgather(local_rows)

@@ -1,0 +1,9 @@
+"""POSITIVE fixture: dispatching a shard_map-produced callable outside
+'with watchdog.deadline(site)' — the program's collectives block
+forever on a dead peer."""
+from jax.experimental.shard_map import shard_map
+
+
+def run_pass(mesh, fn, state, specs):
+    sharded = shard_map(fn, mesh=mesh, in_specs=specs, out_specs=specs)
+    return sharded(state)

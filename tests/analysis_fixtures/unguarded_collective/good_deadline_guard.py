@@ -1,0 +1,20 @@
+"""NEGATIVE fixture: the watchdog contract honored — lexically, and
+through one-hop interprocedural coverage (the parallel/learners.py
+idiom: __call__ arms the deadline, _dispatch runs the collective)."""
+from jax.experimental import multihost_utils
+
+from lightgbm_tpu.parallel import watchdog
+
+
+def sync_row_counts(local_rows):
+    with watchdog.deadline("fixture.row_counts"):
+        return multihost_utils.process_allgather(local_rows)
+
+
+class Learner:
+    def __call__(self, state):
+        with watchdog.deadline("fixture.pass"):
+            return self._dispatch(state)
+
+    def _dispatch(self, state):
+        return multihost_utils.process_allgather(state)

@@ -1,0 +1,26 @@
+"""NEGATIVE fixture: device collectives in traced contexts — a jit
+decorator, a by-name jit wrap, and a helper reached from a traced
+function through the module-local call graph."""
+import jax
+
+
+@jax.jit
+def merge_histograms(hist):
+    return jax.lax.psum(hist, axis_name="d")
+
+
+def _pass(state):
+    return jax.lax.psum_scatter(state, axis_name="d", tiled=True)
+
+
+def build_pass():
+    return jax.jit(_pass)
+
+
+@jax.jit
+def outer(x):
+    return _helper(x)
+
+
+def _helper(x):
+    return jax.lax.pmax(x, axis_name="d")

@@ -481,6 +481,11 @@ def test_fingerprint_excludes_world_size_and_watchdog_params():
                    tpu_heartbeat_dir="/hb", tpu_heartbeat_lease_s=9.0,
                    tpu_elastic_resume=False)
     assert ckpt_mod.config_fingerprint(changed, 1000, 10, "gbdt") == fp
+    # predict/serving-side knobs reshape the serving tier, never the
+    # trajectory: a resumed run may change them freely (ISSUE 13 sweep)
+    serving = dict(base, tpu_predict_quantize="int8",
+                   tpu_predict_micro_batch=16, tpu_serving_deadline_ms=5.0)
+    assert ckpt_mod.config_fingerprint(serving, 1000, 10, "gbdt") == fp
     # trajectory-relevant params still fingerprint
     assert ckpt_mod.config_fingerprint(
         dict(base, num_leaves=15), 1000, 10, "gbdt") != fp

@@ -1,0 +1,339 @@
+"""graftlint (lightgbm_tpu/analysis): the tier-1 zero-findings gate
+plus unit coverage for the engine — per-rule fixture corpus under
+tests/analysis_fixtures/, pragma semantics (reason mandatory, unknown
+rule names are findings), baseline matching/staleness, the JSON report
+schema, and the bytecode-skipping file walker.
+
+The gate test is the point of the PR: `python -m lightgbm_tpu.analysis
+lightgbm_tpu scripts` must exit 0 with zero unsuppressed findings, so
+the invariants the rules encode (prefix-stable RNG, watchdog-armed
+collectives, no host sync under trace, the tpu_* config triangle,
+serving lock/future discipline, stdout hygiene) are enforced on every
+tier-1 run instead of re-learned from the next incident.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from lightgbm_tpu.analysis import RULE_CLASSES, all_rules, run
+from lightgbm_tpu.analysis.core import (PRAGMA_RULES, SCHEMA, Finding,
+                                        iter_python_files)
+from lightgbm_tpu.analysis.rules.padded_rng import PaddedRngRule
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "analysis_fixtures")
+BASELINE = os.path.join(REPO, "graftlint_baseline.json")
+
+
+def _rule_report(rule_name, *rel, baseline=None):
+    rules = [cls() for cls in RULE_CLASSES if cls.name == rule_name]
+    assert rules, f"no registered rule named {rule_name}"
+    return run([os.path.join(FIXTURES, *rel)], rules=rules,
+               baseline_path=baseline)
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 gate
+# ---------------------------------------------------------------------------
+def test_repo_has_zero_unsuppressed_findings():
+    """The merge gate: full rule set over lightgbm_tpu/ and scripts/
+    with the committed baseline. Fix findings at the source; a
+    suppression needs a written reason (pragma or baseline entry)."""
+    report = run([os.path.join(REPO, "lightgbm_tpu"),
+                  os.path.join(REPO, "scripts")],
+                 baseline_path=BASELINE)
+    assert report.files_scanned > 50  # the walker really covered the tree
+    rendered = "\n".join(f.render() for f in report.findings)
+    assert not report.findings, \
+        "unsuppressed graftlint findings (fix them or suppress WITH a " \
+        "reason):\n" + rendered
+    for s in report.suppressions:  # engine contract, asserted anyway
+        assert s.reason.strip(), s.as_dict()
+    assert not report.stale_baseline, \
+        "stale baseline entries (prune them): %r" % report.stale_baseline
+
+
+def test_registry_names_are_unique_and_kebab():
+    names = [cls.name for cls in RULE_CLASSES]
+    assert len(names) == len(set(names))
+    for name in names:
+        assert name and name == name.lower() and "_" not in name
+    assert not set(names) & set(PRAGMA_RULES)
+    with pytest.raises(ValueError, match="unknown rule"):
+        all_rules(["no-such-rule"])
+
+
+# ---------------------------------------------------------------------------
+# per-rule fixture corpus (bad_* must trigger, everything else must not)
+# ---------------------------------------------------------------------------
+FLAT_RULES = {
+    "padded-rng": "padded_rng",
+    "unguarded-collective": "unguarded_collective",
+    "traced-host-sync": "traced_host_sync",
+    "serving-lock": "serving_lock",
+    "future-guard": "future_guard",
+    "stdout-print": "stdout_print",
+}
+
+
+@pytest.mark.parametrize("rule_name", sorted(FLAT_RULES))
+def test_rule_fixture_corpus(rule_name):
+    subdir = FLAT_RULES[rule_name]
+    report = _rule_report(rule_name, subdir)
+    by_file = {}
+    for f in report.findings:
+        by_file.setdefault(os.path.basename(f.path), []).append(f)
+    names = [os.path.basename(p) for p, _ in
+             iter_python_files([os.path.join(FIXTURES, subdir)])]
+    bads = [n for n in names if n.startswith("bad")]
+    goods = [n for n in names if not n.startswith("bad")]
+    assert bads and goods, f"{subdir} needs positive AND negative fixtures"
+    for n in bads:
+        assert by_file.get(n), \
+            f"{subdir}/{n} should trigger {rule_name} and did not"
+        assert all(f.rule == rule_name for f in by_file[n])
+        assert all(f.line > 0 for f in by_file[n])
+    for n in goods:
+        assert n not in by_file, \
+            f"{subdir}/{n} must stay clean, got: " \
+            + "; ".join(f.render() for f in by_file[n])
+
+
+def test_pr11_padded_rng_regression_fixture():
+    """The regression fixture reproduces the shipped PR 11 bug shape —
+    bagging/GOSS masks drawn over (n_pad,) — and the rule names the
+    offending padded identifier in its message."""
+    report = _rule_report("padded-rng", "padded_rng",
+                          "bad_pr11_regression.py")
+    assert len(report.findings) == 2  # bagging mask + GOSS permutation
+    assert all("n_pad" in f.message for f in report.findings)
+    assert all("device count" in f.message for f in report.findings)
+
+
+def test_config_hygiene_clean_tree_is_clean():
+    report = _rule_report("config-hygiene", "config_hygiene", "good")
+    assert not report.findings
+
+
+def test_config_hygiene_doc_match_is_word_bounded(tmp_path):
+    """A param that is a PREFIX of another documented param must still
+    be flagged when its own doc row is missing (review fix: a plain
+    substring test let `tpu_predict_quantize` ride on `..._tol`)."""
+    import shutil
+    tree = tmp_path / "case"
+    shutil.copytree(os.path.join(FIXTURES, "config_hygiene", "good"),
+                    tree)
+    (tree / "pkg" / "config.py").write_text(
+        "from dataclasses import dataclass\n\n\n"
+        "@dataclass\nclass IOConfig:\n"
+        "    tpu_alpha: int = 1\n    tpu_alpha_tol: int = 1\n\n\n"
+        'TPU_PARAM_SPEC = {"tpu_alpha": "bool", "tpu_alpha_tol": "bool"}\n')
+    (tree / "pkg" / "checkpoint.py").write_text(
+        '_FINGERPRINT_EXCLUDE = {"tpu_alpha", "tpu_alpha_tol"}\n'
+        "_FINGERPRINT_INCLUDED = set()\n")
+    # docs mention ONLY the _tol variant: tpu_alpha itself is missing
+    (tree / "docs" / "Parameters.md").write_text("- `tpu_alpha_tol`\n")
+    rules = [cls() for cls in RULE_CLASSES if cls.name == "config-hygiene"]
+    report = run([str(tree)], rules=rules)
+    msgs = [f.message for f in report.findings]
+    assert any("tpu_alpha is not documented" in m for m in msgs), msgs
+    assert not any("tpu_alpha_tol is not documented" in m for m in msgs)
+
+
+def test_quantize_choice_spec_matches_serving_modes():
+    """TPU_PARAM_SPEC keeps its choice row literal (AST-readable,
+    import-free); this pins it to the authoritative
+    serving/forest.QUANTIZE_MODES so the two cannot drift."""
+    from lightgbm_tpu.config import TPU_PARAM_SPEC
+    from lightgbm_tpu.serving.forest import QUANTIZE_MODES
+    assert tuple(TPU_PARAM_SPEC["tpu_predict_quantize"][1:]) == \
+        tuple(QUANTIZE_MODES)
+
+
+def test_config_hygiene_flags_every_drift_leg():
+    report = _rule_report("config-hygiene", "config_hygiene", "bad_drift")
+    msgs = "\n".join(f.message for f in report.findings)
+    for expected in ("tpu_missing_spec",   # no validation spec row
+                     "tpu_stale_row",      # spec row without a field
+                     "tpu_undocumented",   # absent from Parameters.md
+                     "tpu_unclassified",   # no fingerprint decision
+                     "tpu_both",           # double-classified
+                     "tpu_stale_entry"):   # stale fingerprint entry
+        assert expected in msgs, f"missing drift finding for {expected}"
+    # the consistent field drifts nowhere
+    assert "tpu_alpha " not in msgs
+
+
+# ---------------------------------------------------------------------------
+# pragma semantics
+# ---------------------------------------------------------------------------
+def test_pragma_with_reason_suppresses():
+    report = _rule_report("padded-rng", "pragmas", "suppressed_ok.py")
+    assert not report.findings
+    assert [s.finding.rule for s in report.suppressions] == ["padded-rng"]
+    assert report.suppressions[0].via == "pragma"
+    assert "suppression contract" in report.suppressions[0].reason
+
+
+def test_reasonless_pragma_suppresses_nothing_and_is_a_finding():
+    report = _rule_report("padded-rng", "pragmas", "missing_reason.py")
+    rules = sorted(f.rule for f in report.findings)
+    assert rules == ["padded-rng", "pragma-missing-reason"]
+    assert not report.suppressions
+
+
+def test_unknown_rule_pragma_is_a_finding():
+    report = run([os.path.join(FIXTURES, "pragmas", "unknown_rule.py")])
+    assert [f.rule for f in report.findings] == ["pragma-unknown-rule"]
+    assert "no-such-rule" in report.findings[0].message
+
+
+def test_pragma_naming_registered_rule_survives_subset_runs():
+    """conftest's fail-fast stdout gate runs ONE rule; a pragma aimed
+    at another registered rule must not be misreported as unknown."""
+    from lightgbm_tpu.analysis.rules.stdout_print import StdoutPrintRule
+    report = run([os.path.join(FIXTURES, "pragmas", "suppressed_ok.py")],
+                 rules=[StdoutPrintRule()])
+    assert not report.findings
+
+
+# ---------------------------------------------------------------------------
+# baseline semantics
+# ---------------------------------------------------------------------------
+def _bad_fixture_finding():
+    report = _rule_report("padded-rng", "padded_rng",
+                          "bad_pr11_regression.py")
+    assert report.findings
+    return report.findings[0]
+
+
+def test_baseline_suppresses_by_message_and_by_key(tmp_path):
+    f = _bad_fixture_finding()
+    for entry in ({"rule": f.rule, "path": f.path, "message": f.message,
+                   "reason": "grandfathered: fixture exercises matching"},
+                  {"rule": f.rule, "path": f.path, "key": f.key,
+                   "reason": "grandfathered: key-form matching"}):
+        bp = tmp_path / "baseline.json"
+        bp.write_text(json.dumps({"entries": [entry]}))
+        report = _rule_report("padded-rng", "padded_rng",
+                              "bad_pr11_regression.py", baseline=str(bp))
+        suppressed = [s for s in report.suppressions if s.via == "baseline"]
+        assert suppressed and suppressed[0].reason == entry["reason"]
+        assert f.message not in [x.message for x in report.findings]
+        assert not report.stale_baseline
+
+
+def test_baseline_key_is_line_stable():
+    """Baseline identity excludes line/col: edits above a grandfathered
+    finding must not un-suppress it."""
+    f = _bad_fixture_finding()
+    moved = Finding(rule=f.rule, path=f.path, line=f.line + 40,
+                    col=f.col + 4, message=f.message)
+    assert moved.key == f.key
+
+
+def test_stale_baseline_entries_are_reported(tmp_path):
+    bp = tmp_path / "baseline.json"
+    bp.write_text(json.dumps({"entries": [
+        {"rule": "padded-rng", "path": "no/such/file.py",
+         "message": "long gone", "reason": "stale on purpose"}]}))
+    report = _rule_report("padded-rng", "padded_rng",
+                          "good_draw_then_pad.py", baseline=str(bp))
+    assert not report.findings
+    assert len(report.stale_baseline) == 1
+
+
+def test_baseline_entry_without_reason_is_a_finding(tmp_path):
+    f = _bad_fixture_finding()
+    bp = tmp_path / "baseline.json"
+    bp.write_text(json.dumps({"entries": [
+        {"rule": f.rule, "path": f.path, "message": f.message}]}))
+    report = _rule_report("padded-rng", "padded_rng",
+                          "bad_pr11_regression.py", baseline=str(bp))
+    rules = {x.rule for x in report.findings}
+    # the reasonless entry is a finding AND suppresses nothing
+    assert "baseline-missing-reason" in rules
+    assert "padded-rng" in rules
+
+
+def test_committed_baseline_entries_all_carry_reasons():
+    with open(BASELINE) as fh:
+        doc = json.load(fh)
+    for entry in doc["entries"]:
+        assert str(entry.get("reason", "")).strip(), entry
+
+
+# ---------------------------------------------------------------------------
+# CLI and JSON schema
+# ---------------------------------------------------------------------------
+def test_cli_json_schema_and_nonzero_exit():
+    res = subprocess.run(
+        [sys.executable, "-m", "lightgbm_tpu.analysis", "--json",
+         "--no-baseline",
+         os.path.join(FIXTURES, "padded_rng", "bad_pr11_regression.py"),
+         os.path.join(FIXTURES, "padded_rng", "good_draw_then_pad.py")],
+        capture_output=True, text=True, cwd=REPO,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert res.returncode == 1, res.stderr
+    doc = json.loads(res.stdout)
+    assert doc["schema"] == SCHEMA
+    assert doc["exit_code"] == 1
+    assert doc["files_scanned"] == 2
+    assert isinstance(doc["rules"], dict) and "padded-rng" in doc["rules"]
+    assert doc["rules"]["padded-rng"]["findings"] == 2
+    for f in doc["findings"]:
+        assert set(f) == {"rule", "path", "line", "col", "message", "key"}
+    assert doc["suppressions"] == []
+    assert set(doc["baseline"]) == {"path", "entries", "stale"}
+
+
+def test_cli_main_clean_exit_and_rule_listing(capsys):
+    from lightgbm_tpu.analysis.__main__ import main
+    good = os.path.join(FIXTURES, "padded_rng", "good_draw_then_pad.py")
+    assert main(["--no-baseline", good]) == 0
+    out = capsys.readouterr().out
+    assert "0 finding(s)" in out
+    assert main(["--list-rules"]) == 0
+    listed = capsys.readouterr().out
+    for cls in RULE_CLASSES:
+        assert cls.name in listed
+    assert main(["--rules", "no-such-rule", good]) == 2
+
+
+# ---------------------------------------------------------------------------
+# walker hygiene
+# ---------------------------------------------------------------------------
+def test_walker_skips_pycache_and_hidden_dirs(tmp_path):
+    pkg = tmp_path / "pkg"
+    (pkg / "__pycache__").mkdir(parents=True)
+    (pkg / ".hidden").mkdir()
+    (pkg / "mod.py").write_text("x = 1\n")
+    (pkg / "__pycache__" / "junk.py").write_text("print('bytecode dir')\n")
+    (pkg / ".hidden" / "junk.py").write_text("print('tool state')\n")
+    (pkg / "notes.txt").write_text("not python\n")
+    assert [d for _, d in iter_python_files([str(pkg)])] == ["pkg/mod.py"]
+
+
+def test_file_input_keeps_directory_context_for_scoped_rules():
+    """Scanning a single FILE must not strip its directory segments —
+    path-scoped rules (serving-lock/future-guard's `/serving/`,
+    stdout-print's `lightgbm_tpu`) would silently pass on a bare
+    basename (review fix)."""
+    target = os.path.join(FIXTURES, "future_guard", "serving",
+                          "bad_set_result.py")
+    report = _rule_report("future-guard", "future_guard", "serving",
+                          "bad_set_result.py")
+    assert [f.rule for f in report.findings] and \
+        all(f.rule == "future-guard" for f in report.findings)
+    assert all("/serving/" in "/" + f.path for f in report.findings)
+    assert os.path.isfile(target)
+
+
+def test_parse_error_is_a_finding_not_a_crash(tmp_path):
+    bad = tmp_path / "broken.py"
+    bad.write_text("def f(:\n")
+    report = run([str(bad)])
+    assert [f.rule for f in report.findings] == ["parse-error"]
