@@ -12,12 +12,21 @@ The invariant: draw over the REAL extent `(n,)` and pad the RESULT
 (`jnp.pad(jax.random.uniform(key, (n,)), (0, n_pad - n))`), making the
 sample a pure function of (seed, iteration, n) at any world size.
 
+The invariant EXTENDS TO THE MODEL AXIS (ISSUE 14's vmapped sweep,
+learner/sweep.py): per-model draws must come from per-model keys at the
+serial shape `(n,)` — a `(num_models, n)` batched draw makes model k's
+sample a function of the SWEEP WIDTH K, the exact way a padded draw
+makes it a function of the device count, and breaks the sweep's
+byte-identity-to-serial contract. Draw `(n,)` under `jax.vmap` over
+per-model keys instead.
+
 Detection: a call to a `jax.random` sampling function whose ARGUMENT
 expressions mention a padded-dimension identifier — any name or
 attribute with a `pad`/`padded`/`bucket` component (`n_pad`,
-`rows_padded`, `bucket_rows`, ...). Padding the draw's RESULT is fine:
-the padded identifier then sits outside the sampling call's own
-argument list.
+`rows_padded`, `bucket_rows`, ...) — or a model-axis identifier (a
+`models`/`sweep` component: `num_models`, `sweep_size`, ...). Padding
+the draw's RESULT is fine: the padded identifier then sits outside the
+sampling call's own argument list.
 """
 from __future__ import annotations
 
@@ -38,7 +47,11 @@ SAMPLING_FNS = {
     "geometric", "loggamma", "orthogonal", "triangular", "wald",
 }
 
-_PAD_COMPONENTS = {"pad", "padded", "npad", "bucket", "bucketed"}
+_PAD_COMPONENTS = {"pad", "padded", "npad", "bucket", "bucketed",
+                   # model-axis components (the vmapped-sweep extension):
+                   # a draw shaped by the sweep width ties model k's
+                   # sample to K
+                   "models", "sweep", "nmodels"}
 
 
 def _padded_identifier(name: str) -> bool:
@@ -77,10 +90,11 @@ class PaddedRngRule(Rule):
             if offenders:
                 out.append(src.finding(
                     self.name, node,
-                    "RNG draw %s is shaped by padded dimension(s) %s — "
-                    "threefry is not prefix-stable across shapes, so "
-                    "the sample depends on the device count; draw the "
-                    "real extent (n,) and pad the result (the PR 11 "
-                    "bagging/GOSS bug class)"
+                    "RNG draw %s is shaped by padded/model-axis "
+                    "dimension(s) %s — threefry is not prefix-stable "
+                    "across shapes, so the sample depends on the device "
+                    "count (padded dims) or the sweep width (model "
+                    "axis); draw the real extent (n,) per key and pad "
+                    "the result (the PR 11 bagging/GOSS bug class)"
                     % (parts[-1], ", ".join(offenders))))
         return out

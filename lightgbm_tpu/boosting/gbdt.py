@@ -10,6 +10,7 @@ train/valid scores with vectorized leaf lookups.
 """
 from __future__ import annotations
 
+import contextlib
 import copy
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -90,6 +91,55 @@ def _jit_forest_es(stacked_kt, data, margin, freq):
     import jax.numpy as jnp
     return _forest_jit("predict_forest_raw_early_stop", static=("freq",))(
         stacked_kt, data, jnp.float32(margin), freq=freq)
+
+
+def objective_array_keys(obj) -> Tuple[str, ...]:
+    """Names of the objective's row-array attributes. These are passed
+    into gradient jits as ARGUMENTS, never closure captures: a captured
+    [N] array gets inlined into the lowered module as a giant literal
+    (measured 16 MB of HLO text at 2M rows) and defeats the persistent
+    compile cache. Shared by the serial gradient jit below and the
+    sweep grower (learner/sweep.py) so the discovery rule cannot
+    drift."""
+    import jax
+    return tuple(sorted(k for k, v in vars(obj).items()
+                        if isinstance(v, (np.ndarray, jax.Array))))
+
+
+@contextlib.contextmanager
+def objective_arrays_swapped(obj, arr_keys, arrs):
+    """Temporarily rebind the objective's row arrays to the traced
+    argument values for the duration of a trace (the companion of
+    objective_array_keys)."""
+    saved = {k: getattr(obj, k) for k in arr_keys}
+    try:
+        for k, v in arrs.items():
+            setattr(obj, k, v)
+        yield
+    finally:
+        for k, v in saved.items():
+            setattr(obj, k, v)
+
+
+def feature_fraction_mask(rng, frac: float, num_features: int,
+                          num_features_padded: int) -> np.ndarray:
+    """One per-tree feature_fraction sample
+    (serial_tree_learner.cpp:239-257). Module-level because the sweep
+    trainer (boosting/sweep.py) draws each model's masks from ITS own
+    RandomState with the exact serial expression — sharing the code is
+    what keeps the sweep's byte-identity-to-serial contract from
+    drifting."""
+    f = num_features
+    if frac >= 1.0:
+        mask = np.ones(f, bool)
+    else:
+        used = max(1, int(f * frac))
+        idx = rng.choice(f, size=used, replace=False)
+        mask = np.zeros(f, bool)
+        mask[idx] = True
+    if num_features_padded > f:
+        mask = np.pad(mask, (0, num_features_padded - f))
+    return mask
 
 
 def _pad_to(arr: np.ndarray, n: int, value=0):
@@ -780,18 +830,9 @@ class GBDT:
 
     def _feature_mask(self) -> np.ndarray:
         """Per-tree feature_fraction sample (serial_tree_learner.cpp:239-257)."""
-        f = self.train_data.num_features
-        frac = self.config.tree.feature_fraction
-        if frac >= 1.0:
-            mask = np.ones(f, bool)
-        else:
-            used = max(1, int(f * frac))
-            idx = self._feature_rng.choice(f, size=used, replace=False)
-            mask = np.zeros(f, bool)
-            mask[idx] = True
-        if self._num_features_padded > f:
-            mask = np.pad(mask, (0, self._num_features_padded - f))
-        return mask
+        return feature_fraction_mask(
+            self._feature_rng, self.config.tree.feature_fraction,
+            self.train_data.num_features, self._num_features_padded)
 
     def _grow(self, grad, hess, row_weight, feature_mask):
         """Dispatch one tree growth to the serial or distributed grower."""
@@ -823,19 +864,11 @@ class GBDT:
             import jax
 
             obj = self.objective
-            arr_keys = tuple(sorted(
-                k for k, v in vars(obj).items()
-                if isinstance(v, (np.ndarray, jax.Array))))
+            arr_keys = objective_array_keys(obj)
 
             def f(s, arrs):
-                saved = {k: getattr(obj, k) for k in arr_keys}
-                try:
-                    for k, v in arrs.items():
-                        setattr(obj, k, v)
+                with objective_arrays_swapped(obj, arr_keys, arrs):
                     return obj.get_gradients(s.reshape(-1))
-                finally:
-                    for k, v in saved.items():
-                        setattr(obj, k, v)
 
             self._jit_grads = jax.jit(f)
             self._jit_grads_keys = arr_keys

@@ -65,6 +65,11 @@ _FINGERPRINT_EXCLUDE = {
     # allreduce grow bit-identical trees, tests/test_scatter_reduce.py)
     # — a resumed run may switch schedules
     "tpu_hist_reduce",
+    # sweep membership never changes a model's trajectory: a model
+    # trained inside a vmapped sweep is byte-identical to training its
+    # config alone (tests/test_sweep.py), and the registry name prefix
+    # is serving-side bookkeeping
+    "tpu_sweep_size", "tpu_sweep_name_prefix",
     # world-size-elastic resume (ISSUE 11): everything that names or
     # derives from the world size must stay OUT of the fingerprint —
     # a snapshot taken at W ranks must be accepted at W' ranks (trees

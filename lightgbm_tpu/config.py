@@ -180,6 +180,14 @@ class IOConfig:
     # single-process data/voting-parallel mesh (host blocks are freed as
     # they ship, so the binned matrix can exceed one device's HBM)
     tpu_ingest_device_shards: bool = False
+    # many-model sweep training (engine.train_sweep): declared sweep
+    # width — 0 accepts whatever length of param-dict list is given;
+    # > 0 must equal it (a supervisor can pin the fleet size it
+    # provisioned for and have a drifted config list refused loudly)
+    tpu_sweep_size: int = 0
+    # registry name prefix for sweep models published without explicit
+    # names: model k lands as "<prefix>/<k>" (serving.ModelRegistry)
+    tpu_sweep_name_prefix: str = "sweep"
     is_predict_raw_score: bool = False
     is_predict_leaf_index: bool = False
     is_predict_contrib: bool = False
@@ -441,6 +449,9 @@ TPU_PARAM_SPEC = {
     "tpu_ingest": "bool",
     "tpu_ingest_chunk_rows": ("int", 1, None),
     "tpu_ingest_device_shards": "bool",
+
+    "tpu_sweep_size": ("int", 0, None),
+    "tpu_sweep_name_prefix": "str",
     # predict / serving tier
     "tpu_predict_cache": "bool",
     "tpu_predict_bucket_min": ("int", None, None),   # <= 0 disables

@@ -205,6 +205,53 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
     return booster
 
 
+def train_sweep(params_list, train_set: Dataset, num_boost_round: int = 100,
+                names=None, registry=None,
+                warmup_rows: Optional[int] = None) -> List[Booster]:
+    """Train K boosters over ONE shared dataset in lockstep, inside one
+    compiled XLA program per boosting iteration (the many-model tier:
+    hyperparameter sweeps, per-segment fleets of small models).
+
+    `params_list` holds one param dict per model. They may differ only
+    in per-model knobs (regularization, learning rate, sampling seeds
+    and fractions — boosting/sweep.SWEEP_VARIABLE_PARAMS); every other
+    key must agree and a divergence raises a LightGBMError naming it.
+    Every model's trees are byte-identical to training that config alone
+    with `train()` (tests/test_sweep.py).
+
+    When `registry` (a serving.ModelRegistry) is given, the finished
+    boosters are published under `names` — default
+    `<tpu_sweep_name_prefix>/<k>` — through one shared
+    `publish_many` budget/eviction pass. Returns the K Boosters in
+    param order."""
+    from .boosting.sweep import SweepTrainer
+
+    if registry is not None and names is not None \
+            and len(names) != len(params_list):
+        # fail BEFORE the (potentially long) lockstep run, not after
+        raise LightGBMError(
+            "train_sweep got %d names for %d models"
+            % (len(names), len(params_list)))
+    trainer = SweepTrainer(params_list, train_set, num_boost_round)
+    telemetry_mod.heartbeat(0, phase="sweep_init")
+    try:
+        for i in range(trainer.num_boost_round):
+            # the same preemption point engine.train exposes, so fault
+            # harnesses can kill a sweep "after i completed iterations"
+            faults.inject("train.iteration", iteration=i)
+            trainer.step()
+        boosters = trainer.finish()
+    finally:
+        telemetry_mod.heartbeat(trainer._it, phase="sweep_done")
+    if registry is not None:
+        if names is None:
+            prefix = trainer.configs[0].io.tpu_sweep_name_prefix
+            names = [f"{prefix}/{k}" for k in range(len(boosters))]
+        registry.publish_many(list(zip(names, boosters)),
+                              warmup_rows=warmup_rows)
+    return boosters
+
+
 def _check_eval_finite(booster: Booster, results, iteration: int) -> None:
     """A NaN metric means the scores (or the metric's own inputs) went
     bad; every later iteration would train against the same garbage, so

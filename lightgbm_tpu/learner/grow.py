@@ -211,6 +211,32 @@ class GrowerConfig(NamedTuple):
     compact_fraction: float = 0.25
 
 
+class GrowParams(NamedTuple):
+    """TRACED regularization/constraint knobs, as a pytree argument.
+
+    The shape-affecting schedule (num_leaves, max_bins, chunk, batch_k,
+    ...) stays static in GrowerConfig — it decides array shapes and loop
+    structure. These five knobs only enter the f32 gain/output arithmetic,
+    so they can ride as runtime values: `jax.vmap` then maps a [K] array
+    of them over a MODEL axis and K boosters with different
+    regularization train inside ONE compiled program (learner/sweep.py),
+    where the static form would retrace per distinct value. Passing
+    `gp=None` to grow_tree rebuilds them from the static config — the
+    compiled result is bit-identical either way (constants vs runtime
+    scalars feed the same instructions; asserted per-model in
+    tests/test_sweep.py)."""
+    lambda_l1: jnp.ndarray
+    lambda_l2: jnp.ndarray
+    min_gain_to_split: jnp.ndarray
+    min_data_in_leaf: jnp.ndarray
+    min_sum_hessian_in_leaf: jnp.ndarray
+
+    @classmethod
+    def from_config(cls, cfg: "GrowerConfig") -> "GrowParams":
+        return cls(cfg.lambda_l1, cfg.lambda_l2, cfg.min_gain_to_split,
+                   cfg.min_data_in_leaf, cfg.min_sum_hessian_in_leaf)
+
+
 class TreeGrowerState(NamedTuple):
     """Public result of one tree growth (what GBDT / Tree export read)."""
     leaf_id: jnp.ndarray          # [N] i32 committed LEAF SLOT per row
@@ -320,7 +346,8 @@ def _extract_feature_hist(group_hist, sum_g, sum_h, count, fmeta, cfg):
     return jnp.where(at_default[:, :, None], rest, fh)
 
 
-def _leaf_best_split(hist, sum_g, sum_h, count, depth, feature_mask, fmeta, cfg):
+def _leaf_best_split(hist, sum_g, sum_h, count, depth, feature_mask, fmeta,
+                     cfg, gp):
     """Best (gain, feature, ...) for one leaf from its (local) histogram.
 
     Mirrors FindBestSplitsFromHistograms (serial_tree_learner.cpp:451-516):
@@ -334,10 +361,10 @@ def _leaf_best_split(hist, sum_g, sum_h, count, depth, feature_mask, fmeta, cfg)
         hist, sum_g, sum_h, count,
         fmeta["num_bin"], fmeta["missing_type"], fmeta["default_bin"],
         fmeta["is_categorical"],
-        lambda_l1=cfg.lambda_l1, lambda_l2=cfg.lambda_l2,
-        min_gain_to_split=cfg.min_gain_to_split,
-        min_data_in_leaf=cfg.min_data_in_leaf,
-        min_sum_hessian_in_leaf=cfg.min_sum_hessian_in_leaf)
+        lambda_l1=gp.lambda_l1, lambda_l2=gp.lambda_l2,
+        min_gain_to_split=gp.min_gain_to_split,
+        min_data_in_leaf=gp.min_data_in_leaf,
+        min_sum_hessian_in_leaf=gp.min_sum_hessian_in_leaf)
     gains = jnp.where(feature_mask, res.gain, -jnp.inf)
     if cfg.max_depth > 0:
         gains = jnp.where(depth + 1 > cfg.max_depth, -jnp.inf, gains)
@@ -376,7 +403,7 @@ def _leaf_best_split(hist, sum_g, sum_h, count, depth, feature_mask, fmeta, cfg)
 
 
 def _scattered_best_split(hist, sum_g, sum_h, count, depth, feature_mask,
-                          fmeta, owned, gs, cfg):
+                          fmeta, owned, gs, cfg, gp):
     """Owned-slice split finding for the ReduceScatter histogram schedule.
 
     `hist` is this shard's REDUCED [Gl, B, 3] stored-group slice (groups
@@ -403,10 +430,10 @@ def _scattered_best_split(hist, sum_g, sum_h, count, depth, feature_mask,
         fh, sum_g, sum_h, count,
         sub["num_bin"], sub["missing_type"], sub["default_bin"],
         sub["is_categorical"],
-        lambda_l1=cfg.lambda_l1, lambda_l2=cfg.lambda_l2,
-        min_gain_to_split=cfg.min_gain_to_split,
-        min_data_in_leaf=cfg.min_data_in_leaf,
-        min_sum_hessian_in_leaf=cfg.min_sum_hessian_in_leaf)
+        lambda_l1=gp.lambda_l1, lambda_l2=gp.lambda_l2,
+        min_gain_to_split=gp.min_gain_to_split,
+        min_data_in_leaf=gp.min_data_in_leaf,
+        min_sum_hessian_in_leaf=gp.min_sum_hessian_in_leaf)
     gains = jnp.where(ok & feature_mask[fidx], res.gain, -jnp.inf)
     if cfg.max_depth > 0:
         gains = jnp.where(depth + 1 > cfg.max_depth, -jnp.inf, gains)
@@ -438,7 +465,7 @@ def _scattered_best_split(hist, sum_g, sum_h, count, depth, feature_mask,
 
 
 def _voting_children_best(hists_local, sum_g, sum_h, count, depth,
-                          feature_mask, fmeta, cfg):
+                          feature_mask, fmeta, cfg, gp):
     """Voting-parallel best splits for a batch of C children
     (reference: VotingParallelTreeLearner::FindBestSplitsFromHistograms +
     GlobalVoting + CopyLocalHistogram, voting_parallel_tree_learner
@@ -467,10 +494,10 @@ def _voting_children_best(hists_local, sum_g, sum_h, count, depth,
             fh, lt[0], lt[1] + 2e-15, lt[2],
             fmeta["num_bin"], fmeta["missing_type"], fmeta["default_bin"],
             fmeta["is_categorical"],
-            lambda_l1=cfg.lambda_l1, lambda_l2=cfg.lambda_l2,
-            min_gain_to_split=cfg.min_gain_to_split,
-            min_data_in_leaf=max(1, cfg.min_data_in_leaf // m),
-            min_sum_hessian_in_leaf=cfg.min_sum_hessian_in_leaf / m)
+            lambda_l1=gp.lambda_l1, lambda_l2=gp.lambda_l2,
+            min_gain_to_split=gp.min_gain_to_split,
+            min_data_in_leaf=jnp.maximum(1, gp.min_data_in_leaf // m),
+            min_sum_hessian_in_leaf=gp.min_sum_hessian_in_leaf / m)
         return res.gain
 
     gains_local = jax.vmap(local_scan)(hists_local, ltot)    # [C, F]
@@ -516,10 +543,10 @@ def _voting_children_best(hists_local, sum_g, sum_h, count, depth,
             fh_c, g, h, cnt,
             fmeta["num_bin"][eidx], fmeta["missing_type"][eidx],
             fmeta["default_bin"][eidx], fmeta["is_categorical"][eidx],
-            lambda_l1=cfg.lambda_l1, lambda_l2=cfg.lambda_l2,
-            min_gain_to_split=cfg.min_gain_to_split,
-            min_data_in_leaf=cfg.min_data_in_leaf,
-            min_sum_hessian_in_leaf=cfg.min_sum_hessian_in_leaf)
+            lambda_l1=gp.lambda_l1, lambda_l2=gp.lambda_l2,
+            min_gain_to_split=gp.min_gain_to_split,
+            min_data_in_leaf=gp.min_data_in_leaf,
+            min_sum_hessian_in_leaf=gp.min_sum_hessian_in_leaf)
         gains = jnp.where(feature_mask[eidx], res.gain, -jnp.inf)
         if cfg.max_depth > 0:
             gains = jnp.where(d + 1 > cfg.max_depth, -jnp.inf, gains)
@@ -586,7 +613,7 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
               fmeta_default_bin: jnp.ndarray, fmeta_is_cat: jnp.ndarray,
               fmeta_group: jnp.ndarray, fmeta_offset: jnp.ndarray,
               fmeta_is_bundled: jnp.ndarray,
-              cfg: GrowerConfig, n_valid=None, owned_feats=None):
+              cfg: GrowerConfig, n_valid=None, owned_feats=None, gp=None):
     """Grow one leaf-wise tree.
 
     Args:
@@ -608,9 +635,15 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         hist_scatter schedule (-1 padding; each row ascending in global
         feature id) — required when cfg.hist_scatter is active, ignored
         otherwise. Built by parallel.learners.DataParallelGrower.
+      gp: optional GrowParams pytree of TRACED regularization/constraint
+        scalars; None rebuilds them from the static cfg (identical
+        numerics). The vmapped sweep grower maps a [K] model axis over
+        this argument (learner/sweep.py).
     Returns: TreeGrowerState — the host wraps the node arrays and converts
       bin thresholds to raw-space values.
     """
+    if gp is None:
+        gp = GrowParams.from_config(cfg)
     n, g_cols = binned.shape
     L = cfg.num_leaves
     B = cfg.max_bins
@@ -778,17 +811,17 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     if voting:
         root_vals, comm1 = _voting_children_best(
             root_hist[None], root_g[None], root_h[None], root_c[None],
-            jnp.zeros(1, jnp.int32), local_fmask, local_fmeta, cfg)
+            jnp.zeros(1, jnp.int32), local_fmask, local_fmeta, cfg, gp)
         root_vals = tuple(v[0] for v in root_vals)
         root_comm = root_comm + comm1
     elif scatter:
         root_vals = _scattered_best_split(
             root_hist, root_g, root_h, root_c, jnp.int32(0), local_fmask,
-            local_fmeta, owned, gs, cfg)
+            local_fmeta, owned, gs, cfg, gp)
     else:
         root_vals = _leaf_best_split(
             root_hist, root_g, root_h, root_c, jnp.int32(0), local_fmask,
-            local_fmeta, cfg)
+            local_fmeta, cfg, gp)
 
     table = _NodeTable.zeros(M)
     table = table._replace(
@@ -836,7 +869,7 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         sum_h=jnp.zeros(L, jnp.float32).at[0].set(root_h),
         count=jnp.zeros(L, jnp.float32).at[0].set(root_c),
         leaf_value=jnp.zeros(L, jnp.float32).at[0].set(
-            leaf_output(root_g, root_h, cfg.lambda_l1, cfg.lambda_l2)),
+            leaf_output(root_g, root_h, gp.lambda_l1, gp.lambda_l2)),
         leaf_depth=jnp.zeros(L, jnp.int32),
         leaf_parent=jnp.full(L, -1, jnp.int32),
         node_feature=jnp.zeros(L - 1, jnp.int32),
@@ -1054,7 +1087,7 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         if voting:
             vals2, comm = _voting_children_best(
                 hists, all_g, all_h, all_c, all_d,
-                local_fmask, local_fmeta, cfg)
+                local_fmask, local_fmeta, cfg, gp)
         else:
             if cfg.data_axis is not None:
                 comm = jnp.float32(red_c * own_g * B * 3)
@@ -1062,11 +1095,12 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                 split_fn = jax.vmap(
                     lambda h, g, hh, c, d: _scattered_best_split(
                         h, g, hh, c, d, local_fmask, local_fmeta,
-                        owned, gs, cfg))
+                        owned, gs, cfg, gp))
             else:
                 split_fn = jax.vmap(
                     lambda h, g, hh, c, d: _leaf_best_split(
-                        h, g, hh, c, d, local_fmask, local_fmeta, cfg))
+                        h, g, hh, c, d, local_fmask, local_fmeta, cfg,
+                        gp))
             vals2 = split_fn(hists, all_g, all_h, all_c, all_d)
         gain2, feat2, thr2, dl2, cat2, lg2, lh2, lc2 = vals2
 
@@ -1138,8 +1172,8 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         node_right = node_right.at[node].set(~new_slot)
 
         depth_l = carry.leaf_depth[slot_l]
-        lv = leaf_output(lg, lh, cfg.lambda_l1, cfg.lambda_l2)
-        rv = leaf_output(rg, rh, cfg.lambda_l1, cfg.lambda_l2)
+        lv = leaf_output(lg, lh, gp.lambda_l1, gp.lambda_l2)
+        rv = leaf_output(rg, rh, gp.lambda_l1, gp.lambda_l2)
 
         cl, cr = t.child_l[l], t.child_r[l]
         t = t._replace(
@@ -1166,7 +1200,7 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             node_right=node_right,
             node_gain=carry.node_gain.at[node].set(t.gain[l]),
             node_value=carry.node_value.at[node].set(
-                leaf_output(pg, ph, cfg.lambda_l1, cfg.lambda_l2)),
+                leaf_output(pg, ph, gp.lambda_l1, gp.lambda_l2)),
             node_count=carry.node_count.at[node].set(pc),
             num_leaves_used=carry.num_leaves_used + 1,
         )

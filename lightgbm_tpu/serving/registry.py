@@ -49,7 +49,7 @@ import time
 from collections import OrderedDict
 from concurrent.futures import CancelledError as FutureCancelledError
 from concurrent.futures import Future
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 from .. import log, telemetry
 from .admission import CircuitBreaker, ServingOverload, TokenBucket
@@ -141,6 +141,43 @@ class ModelRegistry:
         every accepted future resolves on the model it was accepted
         under. Publishing the same booster again is a cheap no-op swap
         (fresh publish version, same stacks)."""
+        record = self._publish_one(name, booster, warmup_rows)
+        self._enforce_budget()
+        self._mirror_gauges()
+        return record
+
+    def publish_many(self, boosters, warmup_rows: Optional[int] = None
+                     ) -> List[Dict[str, Any]]:
+        """Publish a batch of models — a finished sweep's fleet
+        (engine.train_sweep) — under ONE shared budget/eviction pass.
+
+        `boosters` is a mapping name -> booster or an iterable of
+        (name, booster) pairs. Each model gets the same warm-then-swap
+        treatment as publish(), but the device-memory budget sweep and
+        the gauge mirror run ONCE at the end instead of K times: a
+        K-model sweep whose stacks jointly exceed the budget evicts the
+        coldest residents in one LRU pass rather than churning evict/
+        restack K times mid-batch. Returns the publish records in
+        order."""
+        items = list(boosters.items()) if hasattr(boosters, "items") \
+            else list(boosters)
+        records = []
+        try:
+            for name, booster in items:
+                records.append(self._publish_one(name, booster,
+                                                 warmup_rows))
+        finally:
+            # a mid-batch failure must not leave the already-swapped
+            # models unaccounted: the budget sweep and gauge mirror run
+            # over whatever part of the batch landed
+            self._enforce_budget()
+            self._mirror_gauges()
+        return records
+
+    def _publish_one(self, name: str, booster,
+                     warmup_rows: Optional[int] = None) -> Dict[str, Any]:
+        """One warm + atomic swap + outgoing drain, WITHOUT the budget/
+        gauge pass (the public entries run it after their batch)."""
         with self._lock:
             if self._closed:
                 raise log.LightGBMError("ModelRegistry is closed")
@@ -200,8 +237,6 @@ class ModelRegistry:
                   "model_version": gbdt.model_version(),
                   "warmed_buckets": list(predictor._warmup_buckets)}
         telemetry.counter_add("serving/registry_publishes", 1)
-        self._enforce_budget()
-        self._mirror_gauges()
         log.debug("Registry published %s v%d (model version %d)", name,
                   version, record["model_version"])
         return record

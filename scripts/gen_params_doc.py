@@ -110,6 +110,18 @@ DESCRIPTIONS = {
                                 "mesh (host blocks freed as they ship, "
                                 "so the dataset can exceed one "
                                 "device's HBM)",
+    "tpu_sweep_size": "declared width of a many-model sweep "
+                      "(engine.train_sweep): 0 accepts any length of "
+                      "param-dict list, > 0 refuses a list of any other "
+                      "length (a supervisor can pin the fleet size it "
+                      "provisioned). Sweep membership never changes a "
+                      "model's trees: model k of a vmapped sweep is "
+                      "byte-identical to training its config alone",
+    "tpu_sweep_name_prefix": "serving.ModelRegistry name prefix for "
+                             "sweep models published without explicit "
+                             "names: model k lands as '<prefix>/<k>' "
+                             "through one shared publish_many "
+                             "budget/eviction pass",
     "is_predict_raw_score": "predict raw scores instead of transformed",
     "is_predict_leaf_index": "predict leaf indices per tree",
     "is_predict_contrib": "predict TreeSHAP feature contributions",

@@ -1,0 +1,18 @@
+"""Positive fixture: RNG draw shaped by the MODEL axis of a vmapped
+sweep (ISSUE 14). One batched `(num_models, n)` draw makes model k's
+sample a function of the sweep width K — adding a 17th model to the
+sweep silently changes the first 16 models' bagging masks, breaking the
+byte-identity-to-serial contract the same way a padded draw breaks it
+across device counts."""
+import jax
+
+
+def sweep_bagging_masks(seed, n, num_models):
+    key = jax.random.PRNGKey(seed)
+    # BAD: batched draw over the model axis
+    return jax.random.uniform(key, (num_models, n))
+
+
+def sweep_keep_rows(key, n, sweep_size):
+    # BAD: the sweep width shapes the draw through a kwarg too
+    return jax.random.bernoulli(key, 0.8, shape=(sweep_size, n))

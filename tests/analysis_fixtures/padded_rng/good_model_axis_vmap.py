@@ -1,0 +1,12 @@
+"""Negative fixture: the model-axis-safe sweep idiom — per-model keys,
+each drawing the SERIAL shape (n,) under vmap. Model k's sample is a
+pure function of its own key and n, at any sweep width."""
+import jax
+
+
+def sweep_bagging_masks(seeds, n):
+    def one_model(seed):
+        key = jax.random.PRNGKey(seed)
+        return jax.random.uniform(key, (n,))
+
+    return jax.vmap(one_model)(seeds)

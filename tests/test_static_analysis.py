@@ -113,6 +113,23 @@ def test_pr11_padded_rng_regression_fixture():
     assert all("device count" in f.message for f in report.findings)
 
 
+def test_model_axis_padded_rng_fixture():
+    """The padded-rng invariant extends to the vmapped sweep's MODEL
+    axis (ISSUE 14): a (num_models, n) batched draw ties model k's
+    sample to the sweep width and must be flagged; the per-model-key
+    vmap idiom must stay clean."""
+    report = _rule_report("padded-rng", "padded_rng",
+                          "bad_model_axis.py")
+    assert len(report.findings) == 2  # positional shape + shape= kwarg
+    msgs = [f.message for f in report.findings]
+    assert any("num_models" in m for m in msgs)
+    assert any("sweep_size" in m for m in msgs)
+    assert all("sweep width" in m for m in msgs)
+    clean = _rule_report("padded-rng", "padded_rng",
+                         "good_model_axis_vmap.py")
+    assert not clean.findings
+
+
 def test_config_hygiene_clean_tree_is_clean():
     report = _rule_report("config-hygiene", "config_hygiene", "good")
     assert not report.findings

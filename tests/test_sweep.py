@@ -1,0 +1,247 @@
+"""Vmapped many-model sweep training (ISSUE 14).
+
+The contract under test: model k of `engine.train_sweep` produces trees
+BYTE-IDENTICAL (`model_to_string()` equality) to training that exact
+config alone with `engine.train` — including bagging/GOSS sampling
+seeds, multiclass, and heterogeneous learning rates — while the whole
+sweep steps inside one compiled XLA program per iteration. Plus the
+up-front param-agreement validation (divergent shape-affecting knobs
+raise a LightGBMError NAMING the key) and the registry's shared
+publish_many pass.
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.basic import LightGBMError
+from lightgbm_tpu.engine import train, train_sweep
+
+
+@pytest.fixture(scope="module")
+def binary_data():
+    rng = np.random.RandomState(0)
+    n = 1500
+    X = np.asarray(rng.randn(n, 12), np.float32)
+    X[rng.rand(n, 12) < 0.03] = np.nan  # exercise missing routing
+    y = (np.nan_to_num(X[:, 0]) + 0.5 * np.nan_to_num(X[:, 1]) ** 2
+         + 0.3 * rng.randn(n) > 0.4).astype(np.float32)
+    return X, y
+
+
+BASE = dict(objective="binary", num_leaves=7, max_bin=31, verbosity=-1,
+            min_data_in_leaf=20)
+
+
+def _assert_sweep_matches_serial(plist, X, y, rounds):
+    sweep = train_sweep([dict(p) for p in plist], lgb.Dataset(X, y),
+                        num_boost_round=rounds)
+    assert len(sweep) == len(plist)
+    for k, p in enumerate(plist):
+        serial = train(dict(p), lgb.Dataset(X, y), num_boost_round=rounds)
+        assert sweep[k].model_to_string() == serial.model_to_string(), \
+            f"sweep model {k} diverged from its serial counterpart"
+    return sweep
+
+
+# ---------------------------------------------------------------------------
+# bit-identity matrix
+# ---------------------------------------------------------------------------
+def test_sweep_bit_identity_heterogeneous_regularization(binary_data):
+    """Heterogeneous learning rate AND the traced GrowParams knobs —
+    the serial side bakes them as compile-time constants, the sweep
+    feeds them as runtime [K] arrays; trees must match bitwise."""
+    X, y = binary_data
+    plist = [dict(BASE, learning_rate=0.1, lambda_l2=0.5),
+             dict(BASE, learning_rate=0.2, lambda_l2=1.0, lambda_l1=0.1),
+             dict(BASE, learning_rate=0.05, min_data_in_leaf=5,
+                  min_gain_to_split=0.01)]
+    sweep = _assert_sweep_matches_serial(plist, X, y, rounds=6)
+    # and they are genuinely different models, not one model repeated
+    texts = {b.model_to_string() for b in sweep}
+    assert len(texts) == len(plist)
+
+
+def test_sweep_bit_identity_bagging_seeds(binary_data):
+    """Per-model bagging seeds/fractions: each model's in-bag mask must
+    be a pure function of ITS seed — the padded-rng invariant extended
+    to the model axis. A fraction-1.0 model rides the same program."""
+    X, y = binary_data
+    base = dict(BASE, bagging_freq=1)
+    plist = [dict(base, bagging_fraction=0.8, bagging_seed=3,
+                  learning_rate=0.1),
+             dict(base, bagging_fraction=0.6, bagging_seed=4,
+                  learning_rate=0.15),
+             dict(base, bagging_fraction=1.0, learning_rate=0.1)]
+    _assert_sweep_matches_serial(plist, X, y, rounds=6)
+
+
+def test_sweep_bit_identity_goss(binary_data):
+    """GOSS sweeps: per-model top/other rates, seeds, and — through the
+    heterogeneous learning rates — per-model sampling START iterations
+    (serial skips sampling for the first 1/lr iterations; lr=0.5 starts
+    at 2, lr=0.2 at 5), traced instead of Python-branched."""
+    X, y = binary_data
+    base = dict(BASE, boosting="goss")
+    plist = [dict(base, learning_rate=0.2, top_rate=0.2, other_rate=0.1,
+                  bagging_seed=3),
+             dict(base, learning_rate=0.5, top_rate=0.3, other_rate=0.2,
+                  bagging_seed=4)]
+    _assert_sweep_matches_serial(plist, X, y, rounds=8)
+
+
+def test_sweep_bit_identity_multiclass(binary_data):
+    """Multiclass: the sweep nests the model axis OUTSIDE the existing
+    class-axis vmap (one program grows K x num_class trees)."""
+    X, _ = binary_data
+    rng = np.random.RandomState(1)
+    ym = rng.randint(0, 3, size=X.shape[0]).astype(np.float32)
+    ym = np.where(np.nan_to_num(X[:, 0]) > 0.5, 2.0, ym)
+    base = dict(objective="multiclass", num_class=3, num_leaves=7,
+                max_bin=31, verbosity=-1, min_data_in_leaf=20)
+    plist = [dict(base, learning_rate=0.1, lambda_l2=0.5),
+             dict(base, learning_rate=0.3, lambda_l2=2.0)]
+    _assert_sweep_matches_serial(plist, X, ym, rounds=4)
+
+
+def test_sweep_feature_fraction_streams(binary_data):
+    """Per-model feature_fraction seeds: each model consumes its OWN
+    host RNG stream, one draw per class tree per iteration — the serial
+    draw order exactly."""
+    X, y = binary_data
+    plist = [dict(BASE, feature_fraction=0.6, feature_fraction_seed=11,
+                  learning_rate=0.1),
+             dict(BASE, feature_fraction=0.6, feature_fraction_seed=12,
+                  learning_rate=0.1)]
+    sweep = _assert_sweep_matches_serial(plist, X, y, rounds=5)
+    assert sweep[0].model_to_string() != sweep[1].model_to_string()
+
+
+def test_sweep_stop_truncation(binary_data):
+    """A model whose trees stop splitting is truncated at the serial
+    stop point (engine.train rolls the non-splitting iteration back and
+    stops) even though the lockstep sweep keeps stepping the others."""
+    X, y = binary_data
+    # absurd min_gain blocks every split for model 1 from iteration 0
+    plist = [dict(BASE, learning_rate=0.1),
+             dict(BASE, learning_rate=0.1, min_gain_to_split=1e12)]
+    sweep = train_sweep([dict(p) for p in plist], lgb.Dataset(X, y),
+                        num_boost_round=5)
+    assert sweep[0].num_trees() == 5
+    assert sweep[1].num_trees() == 0
+    serial = train(dict(plist[1]), lgb.Dataset(X, y), num_boost_round=5)
+    assert sweep[1].model_to_string() == serial.model_to_string()
+
+
+def test_sweep_predictions_match_serial(binary_data):
+    """The materialized boosters serve: predictions equal the serial
+    counterpart's (same trees, same objective transform)."""
+    X, y = binary_data
+    plist = [dict(BASE, learning_rate=0.1),
+             dict(BASE, learning_rate=0.3, lambda_l2=3.0)]
+    sweep = train_sweep([dict(p) for p in plist], lgb.Dataset(X, y),
+                        num_boost_round=5)
+    for k, p in enumerate(plist):
+        serial = train(dict(p), lgb.Dataset(X, y), num_boost_round=5)
+        np.testing.assert_array_equal(sweep[k].predict(X[:64]),
+                                      serial.predict(X[:64]))
+
+
+# ---------------------------------------------------------------------------
+# up-front validation
+# ---------------------------------------------------------------------------
+def test_sweep_validation_names_divergent_key(binary_data):
+    X, y = binary_data
+    for key, a, b in [("max_bin", 31, 63), ("num_leaves", 7, 15),
+                      ("max_depth", 3, 4), ("enable_bundle", True, False),
+                      ("bagging_freq", 1, 2)]:
+        plist = [dict(BASE, **{key: a}), dict(BASE, **{key: b})]
+        with pytest.raises(LightGBMError, match=key):
+            train_sweep(plist, lgb.Dataset(X, y), num_boost_round=2)
+
+
+def test_sweep_validation_resolves_aliases(binary_data):
+    """Aliases of per-model knobs must not trip the agreement check:
+    reg_lambda IS lambda_l2."""
+    X, y = binary_data
+    plist = [dict(BASE, reg_lambda=0.5), dict(BASE, lambda_l2=1.0)]
+    sweep = train_sweep(plist, lgb.Dataset(X, y), num_boost_round=2)
+    assert len(sweep) == 2
+
+
+def test_sweep_size_param(binary_data):
+    X, y = binary_data
+    plist = [dict(BASE, tpu_sweep_size=3), dict(BASE, tpu_sweep_size=3)]
+    with pytest.raises(LightGBMError, match="tpu_sweep_size"):
+        train_sweep(plist, lgb.Dataset(X, y), num_boost_round=2)
+    ok = [dict(BASE, tpu_sweep_size=2, learning_rate=lr)
+          for lr in (0.1, 0.2)]
+    assert len(train_sweep(ok, lgb.Dataset(X, y), num_boost_round=2)) == 2
+
+
+def test_goss_sweep_refuses_bagging_up_front(binary_data):
+    """Serial GOSS fatals on bagging at construction; a NON-LEAD sweep
+    model smuggling bagging_fraction<1 past the lead must be refused
+    before the lockstep run, not at finish()."""
+    X, y = binary_data
+    base = dict(BASE, boosting="goss", bagging_freq=1, top_rate=0.2,
+                other_rate=0.1)
+    plist = [dict(base), dict(base, bagging_fraction=0.5)]
+    with pytest.raises(LightGBMError, match="bagging"):
+        train_sweep(plist, lgb.Dataset(X, y), num_boost_round=2)
+
+
+def test_sweep_rejects_unsupported_modes(binary_data):
+    X, y = binary_data
+    with pytest.raises(LightGBMError, match="boosting"):
+        train_sweep([dict(BASE, boosting="dart")] * 2,
+                    lgb.Dataset(X, y), num_boost_round=2)
+    with pytest.raises(LightGBMError, match="serial"):
+        train_sweep([dict(BASE, tree_learner="data")] * 2,
+                    lgb.Dataset(X, y), num_boost_round=2)
+    with pytest.raises(LightGBMError, match="param dict"):
+        train_sweep([], lgb.Dataset(X, y), num_boost_round=2)
+
+
+# ---------------------------------------------------------------------------
+# registry integration
+# ---------------------------------------------------------------------------
+def test_publish_many_shared_pass(binary_data):
+    """publish_many registers a batch under ONE budget/eviction pass:
+    every model resident and serving afterwards, publish counters
+    reflect the batch."""
+    from lightgbm_tpu.serving import ModelRegistry
+    X, y = binary_data
+    b1 = train(dict(BASE, learning_rate=0.1), lgb.Dataset(X, y),
+               num_boost_round=3)
+    b2 = train(dict(BASE, learning_rate=0.3), lgb.Dataset(X, y),
+               num_boost_round=3)
+    reg = ModelRegistry(warmup_rows=0)
+    try:
+        records = reg.publish_many({"a": b1, "b": b2})
+        assert [r["name"] for r in records] == ["a", "b"]
+        assert sorted(reg.models()) == ["a", "b"]
+        assert reg.stats()["publishes"] == 2
+        p1 = reg.predict("a", X[:8])
+        p2 = reg.predict("b", X[:8])
+        assert p1.shape == p2.shape == (8,)
+        assert not np.array_equal(p1, p2)
+    finally:
+        reg.close()
+
+
+def test_train_sweep_lands_in_registry(binary_data):
+    """The engine entry publishes a finished sweep straight into the
+    registry under the tpu_sweep_name_prefix contract."""
+    from lightgbm_tpu.serving import ModelRegistry
+    X, y = binary_data
+    plist = [dict(BASE, learning_rate=0.1, tpu_sweep_name_prefix="fleet"),
+             dict(BASE, learning_rate=0.2, tpu_sweep_name_prefix="fleet")]
+    reg = ModelRegistry(warmup_rows=0)
+    try:
+        boosters = train_sweep(plist, lgb.Dataset(X, y),
+                               num_boost_round=3, registry=reg)
+        assert sorted(reg.models()) == ["fleet/0", "fleet/1"]
+        out = reg.predict("fleet/1", X[:4])
+        np.testing.assert_array_equal(out, boosters[1].predict(X[:4]))
+    finally:
+        reg.close()
