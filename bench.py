@@ -23,6 +23,11 @@ BENCH_SHAPE=lint runs the graftlint static-analysis gate
 (scripts/lint_report.py: zero unsuppressed findings over lightgbm_tpu/
 and scripts/, every suppression carrying a written reason, no stale
 baseline entries — commits LINT_r01.json).
+BENCH_SHAPE=export runs the exported-forest artifact gate
+(scripts/export_smoke.py: f32/f16/int8 round-trip bit-identity,
+corruption/version-skew/fingerprint refusal, and an import-blocked
+child serving the artifact with the training stack absent, zero
+steady-state retraces — commits EXPORT_r01.json).
 BENCH_SHAPE=elastic runs the kill->shrink->resume supervisor cycle
 (scripts/elastic_smoke.py: rank killed at W=4, wedged collective
 detected by the watchdog, elastic resume at W'=2 then W'=1,
@@ -1149,6 +1154,22 @@ def run_overload() -> dict:
         if os.environ.get("BENCH_ALLOW_CPU") == "1" else None)
 
 
+def run_export() -> dict:
+    """Exported-forest gate (BENCH_SHAPE=export): run the artifact
+    round-trip / refusal / import-blocked-cold-serve smoke headlessly
+    and commit the machine-readable artifact (EXPORT_r01.json:
+    per-layout bit-identity, refusal messages, child trainer-absence +
+    zero-retrace verdict). BENCH_ALLOW_CPU=1 pins the child to the CPU
+    backend, the serve/elastic/overload-gate discipline."""
+    return _run_smoke_gate(
+        "export_smoke.py",
+        os.environ.get("BENCH_EXPORT_OUT",
+                       os.path.join(REPO, "EXPORT_r01.json")),
+        "BENCH_EXPORT_TIMEOUT", "export_roundtrip_refusal_coldserve",
+        extra_env={"JAX_PLATFORMS": "cpu"}
+        if os.environ.get("BENCH_ALLOW_CPU") == "1" else None)
+
+
 def main():
     if os.environ.get("BENCH_SWEEP_CHILD") is not None \
             and os.environ.get("BENCH_SWEEP_MODEL_OUT"):
@@ -1182,6 +1203,9 @@ def main():
         # same parent-never-touches-a-backend discipline as elastic:
         # the smoke runs in its own child process
         print(json.dumps(run_overload()), flush=True)
+        return
+    if which == "export":
+        print(json.dumps(run_export()), flush=True)
         return
     _init_backend_with_retry()
     if which == "amortized":

@@ -28,14 +28,46 @@ if _os.environ.get("LIGHTGBM_TPU_COMPILE_CACHE", "1") != "0":
     except Exception:  # pragma: no cover — cache is best-effort
         pass
 
-from .basic import Booster, Dataset  # noqa: F401
-from .engine import cv, train  # noqa: F401
-from . import log  # noqa: F401
+# The public names below resolve lazily (PEP 562).  Training-free serving
+# replicas import `lightgbm_tpu.export.runtime` with the trainer modules
+# (boosting/, learner/, ingest/, parallel/) absent or import-blocked; an
+# eager `from .basic import ...` here would drag the whole training stack
+# into every child process and defeat the export subsystem's isolation.
+_LAZY_ATTRS = {
+    "Booster": ("lightgbm_tpu.basic", "Booster"),
+    "Dataset": ("lightgbm_tpu.basic", "Dataset"),
+    "cv": ("lightgbm_tpu.engine", "cv"),
+    "train": ("lightgbm_tpu.engine", "train"),
+    "log": ("lightgbm_tpu.log", None),
+    "LGBMClassifier": ("lightgbm_tpu.sklearn", "LGBMClassifier"),
+    "LGBMModel": ("lightgbm_tpu.sklearn", "LGBMModel"),
+    "LGBMRanker": ("lightgbm_tpu.sklearn", "LGBMRanker"),
+    "LGBMRegressor": ("lightgbm_tpu.sklearn", "LGBMRegressor"),
+}
 
-try:
-    from .sklearn import (LGBMClassifier, LGBMModel,  # noqa: F401
-                          LGBMRanker, LGBMRegressor)
-except ImportError:  # sklearn not installed
-    pass
+
+def __getattr__(name):
+    try:
+        module_name, attr = _LAZY_ATTRS[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}") from None
+    import importlib
+    try:
+        module = importlib.import_module(module_name)
+    except ImportError as exc:
+        # sklearn wrappers are optional; surface the same AttributeError a
+        # missing eager import used to.
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r} "
+            f"(importing {module_name} failed: {exc})") from None
+    value = module if attr is None else getattr(module, attr)
+    globals()[name] = value
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY_ATTRS))
+
 
 __version__ = "0.1.0"

@@ -18,6 +18,7 @@ from .host_sync import TracedHostSyncRule
 from .config_hygiene import ConfigHygieneRule
 from .serving_locks import FutureGuardRule, ServingLockRule
 from .stdout_print import StdoutPrintRule
+from .export_hygiene import ExportImportHygieneRule
 
 RULE_CLASSES = (
     PaddedRngRule,
@@ -27,6 +28,7 @@ RULE_CLASSES = (
     ServingLockRule,
     FutureGuardRule,
     StdoutPrintRule,
+    ExportImportHygieneRule,
 )
 
 

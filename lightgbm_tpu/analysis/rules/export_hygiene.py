@@ -1,0 +1,130 @@
+"""Rule `export-import-hygiene`: the serving replica's import boundary.
+
+The whole point of `lightgbm_tpu/export/` is that a serving replica
+loads a forest artifact WITHOUT the training stack — the export smoke
+gate proves it by import-blocking `boosting/`, `learner/`, `ingest/`,
+and `parallel/` in a child process. One innocent-looking import (a
+helper moved, a type hint "just for clarity") re-couples the replica to
+the trainer and the gate only catches it at bench time. This rule turns
+the boundary into a static invariant: any module under
+`lightgbm_tpu/export/` whose imports (module-level OR function-local —
+a lazy import still executes on the serving path) resolve into a
+trainer package is a finding. The allowed surface is `ops/`, `serving/`,
+`export/` itself, and the leaf utility modules (log, config, telemetry,
+checkpoint, testing).
+
+Front-door modules (`basic`, `engine`, `cli`, `sklearn`, `dataset`,
+`objectives`, `shap`) are banned too: each imports a trainer package
+transitively, so allowing them would make the direct ban decorative.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional
+
+from ..core import Finding, Rule, SourceFile
+
+EXPORT_SEGMENT = "/export/"
+_PACKAGE = "lightgbm_tpu"
+
+#: trainer packages the ISSUE names, plus the front-door modules that
+#: transitively import them
+_BANNED = {
+    "boosting": "the boosting trainer",
+    "learner": "the tree learner",
+    "ingest": "the streaming ingest stack",
+    "parallel": "the distributed-training stack",
+    "basic": "Booster/Dataset (imports boosting + learner)",
+    "engine": "train()/cv() (imports the full trainer)",
+    "cli": "the CLI front end (imports the full trainer)",
+    "sklearn": "the sklearn wrappers (import engine)",
+    "dataset": "the in-memory dataset builder (trainer-side)",
+    "objectives": "objective functions (trainer-side; artifacts carry "
+                  "the transform spec instead)",
+    "shap": "TreeSHAP (walks trainer-side tree objects)",
+}
+
+
+def _in_scope(src: SourceFile) -> bool:
+    return EXPORT_SEGMENT in "/" + src.display_path
+
+
+def _export_pkg_depth(display_path: str) -> int:
+    """How many package levels `display_path` sits below the package
+    root (export/writer.py -> 2), for resolving relative imports.
+    Anchored on the export/ segment so fixture trees that lack the
+    lightgbm_tpu/ prefix resolve the same way as the real package."""
+    tail = ("/" + display_path).rsplit(EXPORT_SEGMENT, 1)[-1]
+    return 1 + len(tail.split("/"))
+
+
+class ExportImportHygieneRule(Rule):
+    name = "export-import-hygiene"
+    description = ("a module under lightgbm_tpu/export/ imports the "
+                   "training stack (boosting/, learner/, ingest/, "
+                   "parallel/ or a front door to them): serving "
+                   "replicas must load artifacts training-stack-free")
+
+    def check_file(self, src: SourceFile) -> Iterable[Finding]:
+        out: List[Finding] = []
+        if not _in_scope(src):
+            return out
+        depth = _export_pkg_depth(src.display_path)
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    hit = self._banned_module(alias.name)
+                    if hit:
+                        out.append(self._finding(src, node, alias.name,
+                                                 hit))
+            elif isinstance(node, ast.ImportFrom):
+                module = self._absolute_module(node, depth)
+                if module is None:
+                    continue
+                hit = self._banned_module(module)
+                if hit:
+                    out.append(self._finding(src, node, module, hit))
+                    continue
+                # `from lightgbm_tpu import boosting` / `from .. import
+                # engine`: the banned name is the imported attribute
+                if module == _PACKAGE:
+                    for alias in node.names:
+                        sub = "%s.%s" % (_PACKAGE, alias.name)
+                        hit = self._banned_module(sub)
+                        if hit:
+                            out.append(self._finding(src, node, sub, hit))
+        return out
+
+    @staticmethod
+    def _absolute_module(node: ast.ImportFrom, depth: int) -> Optional[str]:
+        """Resolve a (possibly relative) ImportFrom to a dotted module
+        path rooted at the package, or None for foreign imports."""
+        if node.level == 0:
+            return node.module
+        # from . / .. / ... inside lightgbm_tpu/export/<file>: level 1 =
+        # the export package, level 2 = lightgbm_tpu, deeper = outside
+        up = depth - node.level
+        if up < 0:
+            return None
+        parts = [_PACKAGE] + (["export"] if up >= 1 else [])
+        if node.module:
+            parts.append(node.module)
+        return ".".join(parts)
+
+    @staticmethod
+    def _banned_module(module: Optional[str]) -> Optional[str]:
+        if not module:
+            return None
+        parts = module.split(".")
+        if parts[0] != _PACKAGE or len(parts) < 2:
+            return None
+        return _BANNED.get(parts[1])
+
+    def _finding(self, src: SourceFile, node: ast.AST, module: str,
+                 why: str) -> Finding:
+        return src.finding(
+            self.name, node,
+            "export/ imports %s — %s. Serving replicas load artifacts "
+            "with the training stack absent (the export smoke gate "
+            "import-blocks it); keep export/ to ops/, serving/, "
+            "export/ and leaf utility modules" % (module, why))

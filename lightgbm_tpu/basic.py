@@ -659,6 +659,23 @@ class Booster:
         from .serving import Predictor
         return Predictor(self, **kwargs)
 
+    def export_forest(self, path: str, num_iteration: int = -1,
+                      layouts=None, buckets=None,
+                      calibration=None) -> dict:
+        """Pack this booster's compiled-forest layouts into a
+        self-contained serving artifact (`lightgbm_tpu/export/`): f32
+        plus the requested quantized stacks, per bucket of the
+        power-of-two row ladder, traced through `jax.export` so a
+        replica serves them WITHOUT the training stack. Defaults come
+        from `tpu_export_layouts` / `tpu_export_buckets`; `calibration`
+        (real feature rows) freezes the quantize accuracy-gate deltas
+        into the manifest. Returns the writer's summary dict."""
+        from .export import write_artifact
+        return write_artifact(self._inner, path,
+                              num_iteration=num_iteration,
+                              layouts=layouts, buckets=buckets,
+                              calibration=calibration)
+
     def _serving(self) -> "Predictor":
         """Shared default Predictor every Booster.predict routes
         through, so serving counters accumulate per booster."""

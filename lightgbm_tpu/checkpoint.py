@@ -97,6 +97,10 @@ _FINGERPRINT_EXCLUDE = {
     "tpu_predict_pipeline", "tpu_predict_quantize",
     "tpu_predict_quantize_tol", "tpu_predict_warmup_rows",
     "tpu_predict_micro_batch", "tpu_predict_micro_batch_window_ms",
+    # exported-forest artifacts (ISSUE 16): exporting serializes the
+    # already-trained forest for serving replicas — which layouts and
+    # buckets get packed never feeds back into training numerics
+    "tpu_export_dir", "tpu_export_layouts", "tpu_export_buckets",
     "output_model", "output_result", "input_model", "convert_model",
     "config_file", "machine_list_file", "snapshot_freq", "verbose",
     "metric_freq", "num_iterations", "num_threads", "task",

@@ -304,12 +304,10 @@ def run_predict(params: Dict, cfg: Config) -> None:
         from . import telemetry
         telemetry.enable(True)
         telemetry.install_observer()
-    booster = Booster(model_file=cfg.io.input_model, params=dict(params))
     data, _ = load_data_file(cfg.data, has_header=cfg.io.has_header)
-    # serving front end (lightgbm_tpu/serving): device-resident compiled
-    # forest + bucketed, pipelined dispatch; its counters are the CLI's
-    # throughput report
-    predictor = booster.serving_predictor(
+    from . import export as export_mod
+    from .serving import Predictor
+    predictor_kwargs = dict(
         num_iteration=cfg.io.num_iteration_predict,
         raw_score=cfg.io.is_predict_raw_score,
         pred_leaf=cfg.io.is_predict_leaf_index,
@@ -317,6 +315,20 @@ def run_predict(params: Dict, cfg: Config) -> None:
         pred_early_stop=cfg.io.pred_early_stop,
         pred_early_stop_freq=cfg.io.pred_early_stop_freq,
         pred_early_stop_margin=cfg.io.pred_early_stop_margin)
+    if export_mod.is_artifact(cfg.io.input_model):
+        # input_model is an exported-forest artifact: serve it without
+        # constructing a Booster (no training stack, no tree re-parse,
+        # zero Python retracing of the forest)
+        model = export_mod.load_artifact(cfg.io.input_model,
+                                         params=dict(params))
+        predictor = Predictor(model, **predictor_kwargs)
+    else:
+        booster = Booster(model_file=cfg.io.input_model,
+                          params=dict(params))
+        # serving front end (lightgbm_tpu/serving): device-resident
+        # compiled forest + bucketed, pipelined dispatch; its counters
+        # are the CLI's throughput report
+        predictor = booster.serving_predictor(**predictor_kwargs)
     if cfg.io.tpu_predict_quantize != "none":
         # the accuracy-delta gate aborts (loudly) on the first batch if
         # the quantized stacks drift past the tolerance
@@ -371,6 +383,27 @@ def run_predict(params: Dict, cfg: Config) -> None:
         log.info("Serving metrics written to %s", path)
 
 
+def run_export(params: Dict, cfg: Config) -> None:
+    """task=export: pack input_model into a forest artifact under
+    tpu_export_dir (optionally gating quantized layouts on `data` as
+    the calibration batch)."""
+    if not cfg.io.input_model:
+        log.fatal("No input model specified (input_model=...)")
+    from . import export as export_mod
+    booster = Booster(model_file=cfg.io.input_model, params=dict(params))
+    calibration = None
+    if cfg.data:
+        calibration, _ = load_data_file(cfg.data,
+                                        has_header=cfg.io.has_header)
+    path = os.path.join(cfg.io.tpu_export_dir or ".",
+                        export_mod.DEFAULT_NAME)
+    info = booster.export_forest(
+        path, num_iteration=cfg.io.num_iteration_predict,
+        calibration=calibration)
+    log.info("Export finished: %s (%d bytes, %d sections)",
+             info["path"], info["bytes"], info["sections"])
+
+
 def run_convert_model(params: Dict, cfg: Config) -> None:
     """Reference: kConvertModel task (application.cpp:251-258 +
     gbdt_model.cpp ModelToIfElse) — emits standalone C++ if-else code."""
@@ -411,6 +444,8 @@ def main(argv: List[str] = None) -> int:
         run_train(params, cfg)
     elif task in ("predict", "prediction", "test"):
         run_predict(params, cfg)
+    elif task == "export":
+        run_export(params, cfg)
     elif task == "convert_model":
         run_convert_model(params, cfg)
     else:

@@ -267,6 +267,16 @@ class IOConfig:
     tpu_predict_micro_batch: int = 32
     # how long submit() waits for co-arriving rows before dispatching
     tpu_predict_micro_batch_window_ms: float = 0.5
+    # exported-forest artifacts (lightgbm_tpu/export): directory to write
+    # a self-contained StableHLO artifact after training (empty = no
+    # export); serving replicas load it without the training stack
+    tpu_export_dir: str = ""
+    # comma-separated quantized layouts to export alongside f32
+    # ("none" always included): e.g. "f16,int8"; "none" = f32 only
+    tpu_export_layouts: str = "none"
+    # number of power-of-two row buckets to export, starting at
+    # tpu_predict_bucket_min (4 -> buckets of 16/32/64/128 rows)
+    tpu_export_buckets: int = 4
     use_missing: bool = True
     zero_as_missing: bool = False
     sparse_threshold: float = 0.8
@@ -472,6 +482,10 @@ TPU_PARAM_SPEC = {
     "tpu_serving_breaker_failures": ("int", 0, None),
     "tpu_serving_breaker_reset_s": ("float", 0.0, None),
     "tpu_compile_cache_dir": "path",
+    # exported-forest artifacts
+    "tpu_export_dir": "path",
+    "tpu_export_layouts": "str",
+    "tpu_export_buckets": ("int", 1, None),
     # tree / histogram schedule
     "tpu_hist_chunk": ("int", 1, None),
     "tpu_double_precision": "bool",

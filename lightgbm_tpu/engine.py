@@ -181,6 +181,20 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
                 # watchdog heartbeat (LGBM_TPU_HEARTBEAT_FILE) stays armed
                 # even without a recorder; no-op when the env var is unset
                 telemetry_mod.heartbeat(i)
+        # exported-forest artifact (lightgbm_tpu/export): with
+        # tpu_export_dir set, a completed run ends by packing the
+        # training-stack-free serving artifact; the run log records the
+        # publish so a fleet rollout can key on it
+        io_cfg = booster._inner.config.io
+        if getattr(io_cfg, "tpu_export_dir", ""):
+            import os
+
+            from . import export as export_mod
+            booster._inner.finalize_training()
+            info = booster.export_forest(os.path.join(
+                io_cfg.tpu_export_dir, export_mod.DEFAULT_NAME))
+            if recorder is not None:
+                recorder.event("artifact_published", **info)
     except KeyboardInterrupt:
         raise
     finally:

@@ -1,0 +1,78 @@
+"""Exported-forest artifacts: training-stack-free serving.
+
+A trained booster's compiled-forest layouts (f32 + the f16/int8
+quantized stacks, per bucket of the power-of-two row ladder) are traced
+through `jax.export` to StableHLO and packed — together with the tree
+text, the objective's output-transform spec, the feature schema, the
+quantize-gate deltas, and a checksummed manifest — into ONE file a
+serving replica loads without ever importing `boosting/`, `learner/`,
+`ingest/`, or `parallel/` (the `export-import-hygiene` graftlint rule
+keeps that import boundary from eroding):
+
+    magic  b"lightgbm_tpu.forest_artifact.v1\n"
+    <q     header length
+    JSON   manifest: format/jax/StableHLO versions, config fingerprint,
+           model digest, forest metadata (classes, layouts, buckets,
+           transform spec, serving io params), and one descriptor per
+           section {name, kind, dtype, shape, offset, nbytes, crc32}
+    ...    raw section bytes, 64-byte aligned (tree text, stacked-forest
+           leaf arrays, serialized StableHLO functions)
+
+`writer.py` packs the artifact, `loader.py` rehydrates it into a
+`CompiledForest`-backed `ArtifactModel` that satisfies the serving
+surface (`Predictor`, `ModelRegistry`), and `runtime.py` is the
+deliberately minimal replica front end.
+"""
+from __future__ import annotations
+
+from .. import log
+
+MAGIC = b"lightgbm_tpu.forest_artifact.v1\n"
+FORMAT_VERSION = 1
+#: default artifact filename inside `tpu_export_dir`
+DEFAULT_NAME = "forest.artifact"
+
+
+class ArtifactError(log.LightGBMError):
+    """A forest artifact could not be written, or refused to load
+    (version skew, checksum failure, fingerprint mismatch, or a layout
+    the artifact does not carry)."""
+
+
+def is_artifact(path: str) -> bool:
+    """True when `path` starts with the forest-artifact magic (the CLI
+    uses this to route `input_model` between text models and
+    artifacts)."""
+    try:
+        with open(path, "rb") as fh:
+            return fh.read(len(MAGIC)) == MAGIC
+    except OSError:
+        return False
+
+
+# Lazy submodule attribute access keeps `import lightgbm_tpu.export`
+# cheap (the writer pulls in jax.export; a replica that only loads never
+# needs it).
+_LAZY = {
+    "write_artifact": ("lightgbm_tpu.export.writer", "write_artifact"),
+    "read_manifest": ("lightgbm_tpu.export.loader", "read_manifest"),
+    "load_artifact": ("lightgbm_tpu.export.loader", "load_artifact"),
+    "ArtifactModel": ("lightgbm_tpu.export.loader", "ArtifactModel"),
+    "ArtifactServer": ("lightgbm_tpu.export.runtime", "ArtifactServer"),
+}
+
+
+def __getattr__(name):
+    try:
+        module_name, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}") from None
+    import importlib
+    value = getattr(importlib.import_module(module_name), attr)
+    globals()[name] = value
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY))

@@ -1,0 +1,509 @@
+"""Load a forest artifact into a serving-ready model — no training stack.
+
+`load_artifact` verifies the container (magic, format version, jax
+calling-convention skew, per-section CRC) and returns an
+`ArtifactModel`: a frozen, predict-only stand-in for the trainer's GBDT
+that satisfies the whole serving surface (`serving.Predictor`,
+`serving.ModelRegistry`) — `config`, `max_feature_idx`, `predict()`,
+`_compiled_forest`, version listeners, budget accounting.
+
+Zero Python retracing: every packed function deserializes straight from
+StableHLO; `jax.jit(exported.call)` only traces the O(1) call wrapper
+(never the forest computation), and after the warmup walk of the
+exported bucket ladder, steady-state serving emits no trace or compile
+events at all. The layout entries live in a real `CompiledForest`, so
+`ModelRegistry`'s byte budget sees deserialized executables exactly
+like compiled stacks — and an evicted entry re-admits by re-reading the
+artifact file instead of silently retracing.
+"""
+from __future__ import annotations
+
+import json
+import os
+import struct
+import threading
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from . import MAGIC, FORMAT_VERSION, ArtifactError
+from .. import log, telemetry
+from ..serving.forest import (CompiledForest, QUANTIZE_MODES, bucket_rows,
+                              pad_rows)
+
+
+def _resolve_dtype(name: str) -> np.dtype:
+    """Dtype from its stored name; bfloat16/float8 live in ml_dtypes."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _read_header(path: str) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """(manifest, {section name: descriptor}) with container checks."""
+    try:
+        with open(path, "rb") as fh:
+            magic = fh.read(len(MAGIC))
+            if magic != MAGIC:
+                raise ArtifactError(
+                    "%s is not a lightgbm_tpu forest artifact" % path)
+            head = fh.read(8)
+            if len(head) < 8:
+                raise ArtifactError(
+                    "Forest artifact %s is truncated (header length)"
+                    % path)
+            (hlen,) = struct.unpack("<q", head)
+            if not 0 < hlen < (1 << 31):
+                raise ArtifactError(
+                    "Forest artifact %s has a corrupt header length (%d)"
+                    % (path, hlen))
+            blob = fh.read(hlen)
+    except OSError as exc:
+        raise ArtifactError(
+            "Cannot read forest artifact %s: %s" % (path, exc)) from exc
+    if len(blob) < hlen:
+        raise ArtifactError(
+            "Forest artifact %s is truncated (manifest)" % path)
+    try:
+        header = json.loads(blob.decode("utf-8"))
+        manifest = header["manifest"]
+        sections = {d["name"]: d for d in header["sections"]}
+    except (ValueError, KeyError, TypeError) as exc:
+        raise ArtifactError(
+            "Forest artifact %s has a corrupt manifest (%s); the file "
+            "cannot be trusted — re-export it" % (path, exc)) from exc
+    fmt = int(manifest.get("format", 0))
+    if fmt > FORMAT_VERSION:
+        raise ArtifactError(
+            "Forest artifact %s has format version %d; this build "
+            "supports <= %d (manifest section 'format'). Upgrade "
+            "lightgbm_tpu or re-export with the older writer."
+            % (path, fmt, FORMAT_VERSION))
+    return manifest, sections
+
+
+def _check_runtime_compat(path: str, manifest: Dict[str, Any]) -> None:
+    """Refuse jax calling-convention / platform skew up front, with the
+    versions named — never a deserialization traceback."""
+    import jax
+    from jax import export as jax_export
+    ccv = int(manifest.get("calling_convention_version", -1))
+    lo = int(jax_export.minimum_supported_calling_convention_version)
+    hi = int(jax_export.maximum_supported_calling_convention_version)
+    if not lo <= ccv <= hi:
+        raise ArtifactError(
+            "Forest artifact %s was serialized with jax %s (calling "
+            "convention %d); this process runs jax %s, which supports "
+            "%d..%d (manifest section 'calling_convention_version'). "
+            "Re-export the artifact with a compatible jax."
+            % (path, manifest.get("jax_version", "<unknown>"), ccv,
+               jax.__version__, lo, hi))
+    platforms = [str(p) for p in manifest.get("platforms", [])]
+    backend = jax.default_backend()
+    if platforms and backend not in platforms:
+        raise ArtifactError(
+            "Forest artifact %s was exported for platform(s) %s; this "
+            "process runs on %r (manifest section 'platforms'). "
+            "Re-export on a matching backend."
+            % (path, platforms, backend))
+
+
+def _read_section(path: str, fh, desc: Dict[str, Any]) -> bytes:
+    fh.seek(int(desc["offset"]))
+    raw = fh.read(int(desc["nbytes"]))
+    if len(raw) != int(desc["nbytes"]):
+        raise ArtifactError(
+            "Forest artifact %s is truncated (section %r)"
+            % (path, desc["name"]))
+    if zlib.crc32(raw) & 0xFFFFFFFF != int(desc["crc32"]):
+        raise ArtifactError(
+            "Forest artifact %s failed its checksum (section %r); the "
+            "file is corrupt — re-export or re-fetch it"
+            % (path, desc["name"]))
+    return raw
+
+
+def read_manifest(path: str) -> Dict[str, Any]:
+    """The artifact's manifest (cheap: header only, no payload reads)."""
+    manifest, _ = _read_header(path)
+    return manifest
+
+
+class _ExportedFn:
+    """One serialized StableHLO function: lazily deserialized, then
+    served through `jax.jit(exported.call)` so steady-state calls hit
+    the C++ dispatch fast path. Exposes `.nbytes` so
+    `CompiledForest._tree_bytes` budget-accounts it like any stacked
+    array (dropping the wrapper on eviction releases the deserialized
+    executable too)."""
+
+    __slots__ = ("name", "nbytes", "_raw", "_jax_version", "_call",
+                 "_lock")
+
+    def __init__(self, name: str, raw: bytes, jax_version: str):
+        self.name = name
+        self.nbytes = len(raw)
+        self._raw = raw
+        self._jax_version = jax_version
+        self._call = None
+        self._lock = threading.Lock()
+
+    def __call__(self, *args):
+        call = self._call
+        if call is None:
+            with self._lock:
+                call = self._call
+                if call is None:
+                    import jax
+                    from jax import export as jax_export
+                    try:
+                        exported = jax_export.deserialize(self._raw)
+                    except Exception as exc:
+                        raise ArtifactError(
+                            "Section %r of the forest artifact failed to "
+                            "deserialize (written by jax %s, running jax "
+                            "%s): %s" % (self.name, self._jax_version,
+                                         jax.__version__, exc)) from exc
+                    call = self._call = jax.jit(exported.call)
+        return call(*args)
+
+
+class ArtifactModel:
+    """Predict-only GBDT stand-in rehydrated from a forest artifact.
+
+    Satisfies the `serving.Predictor` / `serving.ModelRegistry` model
+    surface. The forest never mutates, so the compiled-forest version is
+    frozen; eviction (registry byte budget) drops the deserialized
+    executables and the next predict re-reads them from the artifact
+    path."""
+
+    _PREDICT_ROW_CHUNK = 1 << 17
+    _PREDICT_ROW_CHUNK_MATMUL = 1 << 19
+
+    def __init__(self, path: str, manifest: Dict[str, Any],
+                 sections: Dict[str, Any], config) -> None:
+        self._path = os.path.abspath(path)
+        self._manifest = manifest
+        self._sections = sections
+        self.config = config
+        forest = manifest["forest"]
+        self.num_class = int(forest["num_class"])
+        self.num_tree_per_iteration = int(forest["num_tree_per_iteration"])
+        self.max_feature_idx = int(forest["max_feature_idx"])
+        self.average_output = bool(forest["average_output"])
+        self.init_score_bias = float(forest["init_score_bias"])
+        self.feature_names = list(forest["feature_names"])
+        self.objective_name = str(forest.get("objective_name", ""))
+        self._total = int(forest["total_trees"])
+        self._num_iteration = int(forest["num_iteration"])
+        self._transform = forest.get("transform")
+        self._has_conv = bool(forest.get("has_conv"))
+        self._layouts = manifest["layouts"]
+        self._buckets = [int(b) for b in manifest["buckets"]]
+        self._bucket_min = int(manifest["bucket_min"])
+        self._gate_deltas = dict(manifest.get("gate_deltas") or {})
+        self.fingerprint = str(manifest.get("fingerprint", ""))
+        self.model_sha256 = str(manifest.get("model_sha256", ""))
+        self._jax_version = str(manifest.get("jax_version", "<unknown>"))
+        self._compiled_forest = CompiledForest()
+        self._version_listeners: List[Any] = []
+        self._quant_gate_defer = False
+
+    # -- GBDT serving-surface compatibility ---------------------------
+    def finalize_training(self) -> None:  # frozen forest: nothing to drain
+        pass
+
+    def model_version(self) -> int:
+        return self._compiled_forest.version
+
+    def add_version_listener(self, fn) -> None:
+        self._version_listeners.append(fn)
+
+    def remove_version_listener(self, fn) -> None:
+        try:
+            self._version_listeners.remove(fn)
+        except ValueError:
+            pass
+
+    def compiled_stack_bytes(self) -> int:
+        return self._compiled_forest.device_bytes()
+
+    def _forest_cache(self) -> CompiledForest:
+        self._compiled_forest.enabled = bool(self.config.io.tpu_predict_cache)
+        return self._compiled_forest
+
+    def _predict_chunk_rows(self, default: int) -> int:
+        c = int(self.config.io.tpu_predict_chunk)
+        c = c if c > 0 else default
+        # cap at the exported ladder top: every chunk's bucket must map
+        # to a packed function (no retracing path exists here)
+        return min(c, self._buckets[-1])
+
+    # -- layout rehydration -------------------------------------------
+    def _serving_mode(self) -> str:
+        mode = str(self.config.io.tpu_predict_quantize or "none").lower()
+        if mode not in QUANTIZE_MODES:
+            raise log.LightGBMError(
+                "tpu_predict_quantize must be one of %s (got %r)"
+                % (QUANTIZE_MODES, mode))
+        if mode not in self._layouts:
+            raise ArtifactError(
+                "Forest artifact %s does not carry layout %r (exported "
+                "layouts: %s); re-export with tpu_export_layouts=%s or "
+                "serve one of the packed layouts"
+                % (self._path, mode, sorted(self._layouts), mode))
+        return mode
+
+    def _check_gate(self, mode: str) -> None:
+        if mode == "none":
+            return
+        delta = self._gate_deltas.get(mode)
+        tol = float(self.config.io.tpu_predict_quantize_tol)
+        if delta is not None and float(delta) > tol:
+            raise log.LightGBMError(
+                "tpu_predict_quantize=%s refused: the artifact's "
+                "recorded calibration delta %.3g exceeds "
+                "tpu_predict_quantize_tol=%.3g. Raise the tolerance or "
+                "serve with tpu_predict_quantize=none."
+                % (mode, float(delta), tol))
+
+    def _load_entry(self, mode: str) -> Dict[str, Any]:
+        """Read one layout's leaves + functions from the artifact file
+        (the CompiledForest build callback — also the re-admission path
+        after a registry budget eviction)."""
+        import jax.numpy as jnp
+        manifest, sections = _read_header(self._path)
+        if manifest.get("model_sha256") != self.model_sha256:
+            raise ArtifactError(
+                "Forest artifact %s changed on disk since it was loaded "
+                "(model digest mismatch); reload it with "
+                "export.load_artifact to serve the new model"
+                % self._path)
+        classes = self._layouts[mode]["classes"]
+        k = self.num_tree_per_iteration
+        leaves: Dict[int, List[Any]] = {}
+        fns: Dict[Tuple[int, int], _ExportedFn] = {}
+        conv: Dict[int, _ExportedFn] = {}
+        with open(self._path, "rb") as fh:
+            for cls in range(k):
+                if cls >= len(classes) or classes[cls]["empty"]:
+                    continue
+                loaded = []
+                for i in range(int(classes[cls]["num_leaves"])):
+                    name = "leaves/%s/%d/%d" % (mode, cls, i)
+                    desc = sections.get(name)
+                    if desc is None:
+                        raise ArtifactError(
+                            "Forest artifact %s is missing section %r"
+                            % (self._path, name))
+                    raw = _read_section(self._path, fh, desc)
+                    arr = np.frombuffer(
+                        raw, dtype=_resolve_dtype(desc["dtype"])).reshape(
+                            tuple(int(s) for s in desc["shape"]))
+                    loaded.append(jnp.asarray(arr))
+                leaves[cls] = loaded
+                for b in self._buckets:
+                    name = "fn/%s/b%d/c%d" % (mode, b, cls)
+                    desc = sections.get(name)
+                    if desc is None:
+                        raise ArtifactError(
+                            "Forest artifact %s is missing section %r"
+                            % (self._path, name))
+                    fns[(b, cls)] = _ExportedFn(
+                        name, _read_section(self._path, fh, desc),
+                        self._jax_version)
+            if self._has_conv:
+                for b in self._buckets:
+                    name = "conv/b%d" % b
+                    desc = sections.get(name)
+                    if desc is None:
+                        raise ArtifactError(
+                            "Forest artifact %s is missing section %r"
+                            % (self._path, name))
+                    conv[b] = _ExportedFn(
+                        name, _read_section(self._path, fh, desc),
+                        self._jax_version)
+        telemetry.counter_add("export/entry_loads", 1)
+        return {"leaves": leaves, "fns": fns, "conv": conv}
+
+    def model_text(self) -> str:
+        """The packed tree-text model (CRC-verified)."""
+        with open(self._path, "rb") as fh:
+            desc = self._sections.get("model_text")
+            if desc is None:
+                raise ArtifactError(
+                    "Forest artifact %s is missing section 'model_text'"
+                    % self._path)
+            return _read_section(self._path, fh, desc).decode("utf-8")
+
+    # -- predict ------------------------------------------------------
+    def _check_num_iteration(self, num_iteration: int) -> None:
+        if num_iteration <= 0:
+            return
+        capped = min(self._total,
+                     num_iteration * self.num_tree_per_iteration)
+        if capped != self._total:
+            raise ArtifactError(
+                "Forest artifact %s is frozen at %d trees "
+                "(num_iteration=%d at export); it cannot serve "
+                "num_iteration=%d — re-export with that cap"
+                % (self._path, self._total, self._num_iteration,
+                   num_iteration))
+
+    def _apply_transform(self, flat):
+        """Replay the objective's convert_output from the manifest spec
+        with the identical jnp expression (see objectives.py)."""
+        import jax.numpy as jnp
+        spec = self._transform or {"kind": "identity"}
+        kind = spec["kind"]
+        if kind == "identity":
+            return flat
+        if kind == "sigmoid_scaled":
+            return 1.0 / (1.0 + jnp.exp(-float(spec["scale"]) * flat))
+        if kind == "sigmoid":
+            return 1.0 / (1.0 + jnp.exp(-flat))
+        if kind == "softmax":
+            import jax
+            return jax.nn.softmax(
+                flat.reshape(int(spec["num_class"]), -1),
+                axis=0).reshape(-1)
+        if kind == "exp":
+            return jnp.exp(flat)
+        if kind == "log1p_exp":
+            return jnp.log1p(jnp.exp(flat))
+        raise ArtifactError(
+            "Forest artifact %s carries unknown transform spec %r; "
+            "it was written by a newer lightgbm_tpu" % (self._path, kind))
+
+    def predict(self, data, num_iteration: int = -1,
+                raw_score: bool = False, pred_leaf: bool = False,
+                pred_contrib: bool = False, pred_early_stop: bool = False,
+                pred_early_stop_freq: int = 10,
+                pred_early_stop_margin: float = 10.0) -> np.ndarray:
+        import jax.numpy as jnp
+        if pred_leaf or pred_contrib or pred_early_stop:
+            raise ArtifactError(
+                "Exported artifacts serve value predictions only; "
+                "pred_leaf/pred_contrib/pred_early_stop need the full "
+                "model (load the tree text with Booster(model_file=...))")
+        self._check_num_iteration(num_iteration)
+        data = np.asarray(data, np.float32)
+        if data.ndim != 2:
+            raise log.LightGBMError(
+                "Prediction input must be 2-D [rows, features] "
+                "(got shape %s)" % (tuple(data.shape),))
+        n = data.shape[0]
+        k = self.num_tree_per_iteration
+        mode = self._serving_mode()
+        self._check_gate(mode)
+        entry = None
+        if self._total > 0:
+            entry = self._forest_cache()._get(
+                ("artifact", mode), lambda: self._load_entry(mode))
+        use_fused = (not raw_score) and self._has_conv \
+            and entry is not None
+        denom = float(max(self._total // k, 1)) \
+            if self.average_output else 1.0
+        bias = float(self.init_score_bias)
+        out = np.zeros((k, n), np.float64)
+        if entry is not None and n > 0:
+            chunk = self._predict_chunk_rows(self._PREDICT_ROW_CHUNK)
+            pipeline = bool(self.config.io.tpu_predict_pipeline)
+            fns, conv = entry["fns"], entry["conv"]
+
+            def dispatch(dj, bucket):
+                devs = []
+                for cls in range(k):
+                    fn = fns.get((bucket, cls))
+                    if fn is None:
+                        devs.append(None)
+                        continue
+                    r = fn(entry["leaves"][cls], dj)
+                    if use_fused:
+                        r = conv[bucket](r, jnp.float32(denom),
+                                         jnp.float32(bias))
+                    devs.append(r)
+                return devs
+
+            def fetch(sl, nrows, devs):
+                for cls, dev in enumerate(devs):
+                    if dev is not None:
+                        out[cls, sl] = np.asarray(dev, np.float64)[:nrows]
+
+            pending = None
+            for i in range(0, n, chunk):
+                nrows = min(chunk, n - i)
+                bucket = bucket_rows(nrows, self._bucket_min, chunk)
+                if (bucket, 0) not in fns and any(
+                        not c["empty"]
+                        for c in self._layouts[mode]["classes"]):
+                    raise ArtifactError(
+                        "Forest artifact %s has no packed function for "
+                        "bucket %d (exported buckets: %s); the serving "
+                        "config's bucket ladder must match the export"
+                        % (self._path, bucket, self._buckets))
+                dj = jnp.asarray(pad_rows(data[i:i + nrows], bucket))
+                telemetry.counter_add("export/serve_chunks", 1)
+                devs = dispatch(dj, bucket)
+                if pending is not None:
+                    fetch(*pending)
+                pending = (slice(i, i + nrows), nrows, devs)
+                if not pipeline:
+                    fetch(*pending)
+                    pending = None
+            if pending is not None:
+                fetch(*pending)
+        if use_fused:
+            return out.T[:, 0]
+        if self.average_output and self._total > 0:
+            out /= max(self._total // k, 1)
+        out += self.init_score_bias
+        raw = out.T
+        if raw_score or self._transform is None:
+            return raw[:, 0] if raw.shape[1] == 1 else raw
+        conv_host = np.asarray(self._apply_transform(
+            jnp.asarray(raw.T.reshape(-1), jnp.float32)), np.float64)
+        if k == 1:
+            return conv_host
+        return conv_host.reshape(k, -1).T
+
+
+def load_artifact(path: str, params: Optional[Dict[str, Any]] = None,
+                  expect_fingerprint: Optional[str] = None
+                  ) -> ArtifactModel:
+    """Open a forest artifact and return a serving-ready ArtifactModel.
+
+    `params`: serving-side overrides merged over the io params frozen at
+    export (e.g. {"tpu_predict_quantize": "int8"}).
+    `expect_fingerprint`: the training-config fingerprint the caller
+    believes current (`checkpoint.config_fingerprint`); a mismatch means
+    the artifact is stale relative to a re-trained model and the load is
+    refused.
+    """
+    from ..config import Config
+    with telemetry.span("export/load"):
+        manifest, sections = _read_header(path)
+        _check_runtime_compat(path, manifest)
+        fp = str(manifest.get("fingerprint", ""))
+        if expect_fingerprint is not None and fp \
+                and fp != expect_fingerprint:
+            raise ArtifactError(
+                "Forest artifact %s was exported from a different "
+                "training configuration (artifact fingerprint %s..., "
+                "expected %s...): the model has been re-trained since "
+                "this artifact was packed. Re-export it."
+                % (path, fp[:12], expect_fingerprint[:12]))
+        merged = dict(manifest.get("io_params") or {})
+        merged.update(params or {})
+        cfg = Config.from_params(merged)
+        model = ArtifactModel(path, manifest, sections, cfg)
+    telemetry.counter_add("export/loads", 1)
+    telemetry.counter_add("export/load_bytes", os.path.getsize(path))
+    log.info("Loaded forest artifact %s: %d trees x %d class(es), "
+             "layouts %s, buckets %s", path, model._total,
+             model.num_tree_per_iteration, sorted(manifest["layouts"]),
+             manifest["buckets"])
+    return model

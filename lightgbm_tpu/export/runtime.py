@@ -1,0 +1,80 @@
+"""Minimal serving front end over a forest artifact.
+
+This module is what a serving replica imports — nothing else. Its
+module-level imports are deliberately restricted to `ops/`, `serving/`,
+and `export/` (plus the leaf utility modules `log`/`telemetry`): the
+training stack (`boosting/`, `learner/`, `ingest/`, `parallel/`) must
+never be reachable from here, and the `export-import-hygiene` graftlint
+rule turns any such import into a finding. A replica container can ship
+with those packages deleted and `ArtifactServer` still serves.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from . import ArtifactError, is_artifact
+from .. import log, telemetry
+from ..serving.predictor import Predictor
+from .loader import ArtifactModel, load_artifact
+
+
+class ArtifactServer:
+    """predict/predict_one over an exported artifact, with the full
+    serving armor (admission control, deadlines, single-flight compile
+    guard, micro-batching) inherited from `serving.Predictor`.
+
+        server = ArtifactServer("/models/forest.artifact")
+        probs = server.predict(rows)
+
+    `params` overrides the serving io knobs frozen at export (e.g.
+    {"tpu_predict_quantize": "int8"}); `warmup_rows=0` skips the
+    bucket-ladder warmup (default walks exactly the exported ladder)."""
+
+    def __init__(self, path: str, params: Optional[Dict[str, Any]] = None,
+                 warmup_rows: Optional[int] = None,
+                 expect_fingerprint: Optional[str] = None) -> None:
+        if not is_artifact(path):
+            raise ArtifactError(
+                "%s is not a forest artifact (expected the "
+                "lightgbm_tpu.forest_artifact magic); train with "
+                "tpu_export_dir= or call Booster.export_forest() to "
+                "produce one" % path)
+        self.model: ArtifactModel = load_artifact(
+            path, params=params, expect_fingerprint=expect_fingerprint)
+        self.predictor = Predictor(self.model)
+        if warmup_rows is None or warmup_rows > 0:
+            info = self.predictor.warmup(warmup_rows)
+            telemetry.counter_add("export/warmup_buckets",
+                                  len(info["buckets"]))
+
+    def num_features(self) -> int:
+        return self.predictor.num_features()
+
+    def predict(self, data, deadline_ms: Optional[float] = None,
+                **overrides) -> np.ndarray:
+        return self.predictor.predict(data, deadline_ms=deadline_ms,
+                                      **overrides)
+
+    def predict_one(self, row, deadline_ms: Optional[float] = None,
+                    **overrides):
+        return self.predictor.predict_one(row, deadline_ms=deadline_ms,
+                                          **overrides)
+
+    def stats(self) -> Dict[str, Any]:
+        out = self.predictor.stats()
+        out["artifact_path"] = self.model._path
+        out["artifact_fingerprint"] = self.model.fingerprint
+        out["artifact_buckets"] = list(self.model._buckets)
+        out["artifact_layouts"] = sorted(self.model._layouts)
+        return out
+
+    def close(self) -> None:
+        self.predictor.close()
+
+    def __enter__(self) -> "ArtifactServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,377 @@
+"""Pack a trained booster into a forest artifact (`jax.export`).
+
+The writer owns the only `jax.export.export` call sites in the repo.
+Bit-identity with the in-process predict path is structural, not
+tested-into-existence: each (layout, bucket, class) pair is traced as
+the SAME kernel dispatch `GBDT._class_stack_dev` performs (the jaxpr of
+`fn(leaves, data) = kernel(unflatten(leaves), data)` is the jaxpr of
+`jax.jit(kernel)(entry, data)` — pytree arguments flatten to the same
+leaf list either way), and the k==1 fused output transform is traced
+from the objective's own `convert_output`, mirroring the two-program
+split of `GBDT.predict`. Kernels are row-independent, so the bucket
+padding a replica slices off can never perturb real rows.
+
+Import hygiene: this module runs against a live GBDT instance passed in
+by the caller — it calls its methods but never imports `boosting/` (the
+`export-import-hygiene` graftlint rule enforces that for the whole
+package).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import struct
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from . import MAGIC, FORMAT_VERSION, ArtifactError
+from .. import log, telemetry
+from ..serving.forest import bucket_ladder, bucket_rows, pad_rows
+
+_ALIGN = 64
+
+#: serving/predict io knobs frozen into the artifact so a replica
+#: reproduces the exporting process's dispatch behavior without the
+#: training config file (load-time `params=` overrides win)
+_IO_PARAM_FIELDS = (
+    "tpu_predict_cache", "tpu_predict_bucket_min", "tpu_predict_chunk",
+    "tpu_predict_pipeline", "tpu_predict_quantize",
+    "tpu_predict_quantize_tol", "tpu_predict_warmup_rows",
+    "tpu_predict_micro_batch", "tpu_predict_micro_batch_window_ms",
+    "tpu_serving_budget_mb", "tpu_serving_max_queue",
+    "tpu_serving_max_inflight", "tpu_serving_deadline_ms",
+    "tpu_serving_model_qps", "tpu_serving_breaker_failures",
+    "tpu_serving_breaker_reset_s", "tpu_compile_cache_dir",
+)
+
+#: objective-name -> host output-transform spec for the k>1 path
+#: (`GBDT.predict` applies `objective.convert_output` eagerly on host
+#: fetch; the loader replays the spec with the identical jnp expression,
+#: so the table below must stay in lockstep with objectives.py)
+_TRANSFORM_BY_NAME = {
+    "binary": "sigmoid_scaled",
+    "multiclassova": "sigmoid_scaled",
+    "multiclass": "softmax",
+    "xentropy": "sigmoid",
+    "xentlambda": "log1p_exp",
+    "poisson": "exp",
+}
+
+
+def _transform_spec(obj) -> Optional[Dict[str, Any]]:
+    """JSON-able spec of `obj.convert_output` (None = identity)."""
+    if obj is None:
+        return None
+    kind = _TRANSFORM_BY_NAME.get(obj.name)
+    if kind is None:
+        # regression family and lambdarank inherit the identity
+        # convert_output; a custom objective that overrides it without a
+        # spec entry cannot be replayed training-stack-free
+        base = type(obj).convert_output
+        for klass in type(obj).__mro__:
+            if klass.__name__ == "ObjectiveFunction":
+                if base is not klass.convert_output:
+                    raise ArtifactError(
+                        "Objective %r overrides convert_output but has "
+                        "no exportable transform spec; add it to "
+                        "export/writer._TRANSFORM_BY_NAME" % obj.name)
+                break
+        return {"kind": "identity"}
+    spec: Dict[str, Any] = {"kind": kind}
+    if kind == "sigmoid_scaled":
+        spec["scale"] = float(obj.sigmoid)
+    elif kind == "softmax":
+        spec["num_class"] = int(obj.num_class)
+    return spec
+
+
+def _entry_fn(treedef, mode: str):
+    """The exported computation for one class's stacked forest: exactly
+    the `GBDT._class_stack_dev` dispatch, closed over the entry's pytree
+    structure so a replica calls it with a flat leaf list."""
+    import jax
+
+    from ..ops import predict as predict_ops
+
+    def fn(leaves, data):
+        entry = jax.tree.unflatten(treedef, leaves)
+        if mode == "int8":
+            qf, st = entry
+            if qf is not None:
+                return predict_ops.predict_forest_quant(qf, data)
+            return predict_ops.predict_forest_raw(st, data)
+        mf, st = entry
+        if mf is not None:
+            if mode == "f16":
+                return predict_ops.predict_forest_f16(mf, data)
+            return predict_ops.predict_forest_raw_matmul(mf, data)
+        return predict_ops.predict_forest_raw(st, data)
+
+    return fn
+
+
+def _export_layouts(io, layouts: Optional[List[str]]) -> List[str]:
+    from ..serving.forest import QUANTIZE_MODES
+    if layouts is None:
+        layouts = [s.strip() for s in
+                   str(io.tpu_export_layouts or "none").split(",") if s.strip()]
+    modes = ["none"]  # f32 is always packed: it is the gate reference
+    for m in layouts:
+        m = m.lower()
+        if m not in QUANTIZE_MODES:
+            raise ArtifactError(
+                "tpu_export_layouts entry %r is not one of %s"
+                % (m, QUANTIZE_MODES))
+        if m not in modes:
+            modes.append(m)
+    return modes
+
+
+def _export_buckets(io, buckets) -> Tuple[int, List[int]]:
+    bucket_min = int(io.tpu_predict_bucket_min)
+    if bucket_min <= 0:
+        raise ArtifactError(
+            "Exported artifacts require the bucket ladder "
+            "(tpu_predict_bucket_min > 0): every packed function is "
+            "compiled for one bucket shape")
+    if buckets is None:
+        steps = max(1, int(io.tpu_export_buckets))
+        return bucket_min, bucket_ladder(bucket_min, bucket_min << (steps - 1))
+    want = sorted({int(b) for b in buckets})
+    ladder = bucket_ladder(bucket_min, max(want))
+    if want != ladder:
+        raise ArtifactError(
+            "buckets=%s is not the power-of-two ladder from "
+            "tpu_predict_bucket_min=%d (expected %s): request dispatch "
+            "walks the ladder, so gaps would retrace at serve time"
+            % (want, bucket_min, ladder))
+    return bucket_min, ladder
+
+
+def _crc(raw: bytes) -> int:
+    return zlib.crc32(raw) & 0xFFFFFFFF
+
+
+def _gate_deltas(gbdt, cache, modes, k, total, stacks_by_mode,
+                 calibration) -> Dict[str, Optional[float]]:
+    """Measured quantize-gate deltas per layout (the in-process
+    `GBDT._quant_gate` measurement, run at pack time so a replica can
+    enforce `tpu_predict_quantize_tol` without the f32 comparison)."""
+    deltas: Dict[str, Optional[float]] = {}
+    for mode in modes:
+        if mode == "none":
+            continue
+        key = ("value", total, k, mode)
+        delta = cache.gate_delta(key)
+        if delta is None and calibration is not None \
+                and calibration.shape[0] > 0:
+            calib = np.asarray(calibration, np.float32)
+            defer = getattr(gbdt, "_quant_gate_defer", False)
+            gbdt._quant_gate_defer = False
+            try:
+                gbdt._quant_gate(cache, mode, k, total,
+                                 stacks_by_mode[mode], calib)
+            finally:
+                gbdt._quant_gate_defer = defer
+            delta = cache.gate_delta(key)
+        deltas[mode] = None if delta is None else float(delta)
+    return deltas
+
+
+def write_artifact(booster, path: str, num_iteration: int = -1,
+                   layouts: Optional[List[str]] = None,
+                   buckets: Optional[List[int]] = None,
+                   calibration: Optional[np.ndarray] = None
+                   ) -> Dict[str, Any]:
+    """Serialize `booster`'s compiled-forest layouts to `path`.
+
+    Returns a summary dict {path, bytes, sections, layouts, buckets,
+    fingerprint}. `calibration` (optional real feature rows) runs the
+    quantize accuracy gate at pack time and freezes the measured deltas
+    into the manifest.
+    """
+    import jax
+    from jax import export as jax_export
+
+    gbdt = getattr(booster, "_inner", booster)
+    gbdt.finalize_training()
+    io = gbdt.config.io
+    modes = _export_layouts(io, layouts)
+    bucket_min, ladder = _export_buckets(io, buckets)
+    k = int(gbdt.num_tree_per_iteration)
+    total = int(gbdt._capped_total(num_iteration))
+    num_features = int(gbdt.max_feature_idx) + 1
+
+    with telemetry.span("export/write"):
+        model_text = gbdt.save_model_to_string(num_iteration)
+        cache = gbdt._forest_cache()
+        sections: List[Tuple[Dict[str, Any], bytes]] = []
+
+        def add_section(name: str, kind: str, raw: bytes,
+                        dtype: str = "", shape=()) -> None:
+            sections.append(({"name": name, "kind": kind, "dtype": dtype,
+                              "shape": list(shape), "offset": 0,
+                              "nbytes": len(raw), "crc32": _crc(raw)}, raw))
+
+        add_section("model_text", "text", model_text.encode("utf-8"))
+
+        from ..ops.predict import QuantRefused
+        stacks_by_mode: Dict[str, Any] = {}
+        layout_meta: Dict[str, Any] = {}
+        platforms: Optional[Tuple[str, ...]] = None
+        ccv = None
+        n_fns = 0
+        for mode in modes:
+            if total > 0:
+                try:
+                    class_stacks = cache.value_stacks(gbdt.models, k, total,
+                                                      quantize=mode)
+                except QuantRefused as exc:
+                    raise ArtifactError(
+                        "layout %r refused for this model: %s"
+                        % (mode, exc)) from exc
+            else:
+                class_stacks = [(None, None)] * k
+            stacks_by_mode[mode] = class_stacks
+            classes = []
+            for cls, entry in enumerate(class_stacks):
+                leaves, treedef = jax.tree.flatten(entry)
+                empty = all(x is None for x in entry)
+                classes.append({"empty": empty, "num_leaves": len(leaves)})
+                if empty:
+                    continue
+                for i, leaf in enumerate(leaves):
+                    a = np.asarray(leaf)
+                    add_section("leaves/%s/%d/%d" % (mode, cls, i), "array",
+                                a.tobytes(), dtype=a.dtype.name,
+                                shape=a.shape)
+                leaf_specs = [jax.ShapeDtypeStruct(x.shape, x.dtype)
+                              for x in leaves]
+                fn = _entry_fn(treedef, mode)
+                for b in ladder:
+                    data_spec = jax.ShapeDtypeStruct((b, num_features),
+                                                     np.float32)
+                    exp = jax_export.export(jax.jit(fn))(leaf_specs,
+                                                         data_spec)
+                    platforms = tuple(exp.platforms)
+                    ccv = int(exp.calling_convention_version)
+                    add_section("fn/%s/b%d/c%d" % (mode, b, cls),
+                                "exported", exp.serialize())
+                    n_fns += 1
+            layout_meta[mode] = {"classes": classes}
+
+        # the k==1 fused output transform, traced from the objective's
+        # own convert_output — the second half of GBDT.predict's
+        # two-program fast path
+        obj = gbdt.objective
+        has_conv = bool(obj is not None and k == 1 and total > 0)
+        if has_conv:
+            def _conv(r, d, b):
+                return obj.convert_output(r / d + b)
+
+            for b in ladder:
+                exp = jax_export.export(jax.jit(_conv))(
+                    jax.ShapeDtypeStruct((b,), np.float32),
+                    jax.ShapeDtypeStruct((), np.float32),
+                    jax.ShapeDtypeStruct((), np.float32))
+                platforms = tuple(exp.platforms)
+                ccv = int(exp.calling_convention_version)
+                add_section("conv/b%d" % b, "exported", exp.serialize())
+                n_fns += 1
+
+        gate_deltas = _gate_deltas(gbdt, cache, modes, k, total,
+                                   stacks_by_mode, calibration)
+
+        raw_params = dict(getattr(gbdt.config, "raw_params", {}) or {})
+        n_fp = int(getattr(getattr(gbdt, "train_data", None),
+                           "num_global_rows", 0)
+                   or getattr(gbdt, "_n", 0) or 0)
+        from .. import checkpoint
+        fingerprint = checkpoint.config_fingerprint(
+            raw_params, n_fp, num_features, gbdt.config.boosting_type)
+
+        io_params = {f: getattr(io, f) for f in _IO_PARAM_FIELDS
+                     if hasattr(io, f)}
+        # a replica's warmup must walk exactly the exported ladder —
+        # buckets past the artifact's top would retrace from scratch
+        io_params["tpu_predict_warmup_rows"] = int(ladder[-1])
+        io_params["tpu_predict_bucket_min"] = int(bucket_min)
+
+        manifest = {
+            "format": FORMAT_VERSION,
+            "jax_version": jax.__version__,
+            "calling_convention_version": ccv,
+            "platforms": list(platforms) if platforms else [],
+            "fingerprint": fingerprint,
+            "model_sha256": hashlib.sha256(
+                model_text.encode("utf-8")).hexdigest(),
+            "forest": {
+                "num_class": int(gbdt.num_class),
+                "num_tree_per_iteration": k,
+                "total_trees": total,
+                "num_iteration": int(num_iteration),
+                "max_feature_idx": int(gbdt.max_feature_idx),
+                "average_output": bool(gbdt.average_output),
+                "init_score_bias": float(gbdt.init_score_bias),
+                "objective": obj.to_string() if obj is not None else "",
+                "objective_name": obj.name if obj is not None else "",
+                "transform": _transform_spec(obj),
+                "has_conv": has_conv,
+                "feature_names": list(gbdt.feature_names),
+            },
+            "layouts": layout_meta,
+            "buckets": ladder,
+            "bucket_min": bucket_min,
+            "gate_deltas": gate_deltas,
+            "io_params": io_params,
+        }
+
+        def render(descs):
+            return json.dumps({"manifest": manifest, "sections": descs},
+                              sort_keys=True).encode()
+
+        descs = [d for d, _ in sections]
+        # measure the header with worst-case offset widths (an artifact
+        # can carry hundreds of sections, so fixed slack would not
+        # scale), then pad to that length after the real offsets land
+        for d in descs:
+            d["offset"] = 1 << 53
+        hlen = len(render(descs)) + 64
+        base = len(MAGIC) + 8 + hlen
+        base = ((base + _ALIGN - 1) // _ALIGN) * _ALIGN
+        off = base
+        for d, raw in sections:
+            d["offset"] = off
+            off = ((off + len(raw) + _ALIGN - 1) // _ALIGN) * _ALIGN
+        blob = render(descs)
+        if len(blob) > hlen:  # pragma: no cover — measured width always fits
+            raise ArtifactError("artifact header overflow")
+        blob = blob + b" " * (hlen - len(blob))
+
+        out_dir = os.path.dirname(os.path.abspath(path))
+        os.makedirs(out_dir, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as fh:
+            fh.write(MAGIC)
+            fh.write(struct.pack("<q", hlen))
+            fh.write(blob)
+            for d, raw in sections:
+                fh.seek(d["offset"])
+                fh.write(raw)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+        nbytes = os.path.getsize(path)
+
+    telemetry.counter_add("export/artifact_bytes", nbytes)
+    telemetry.counter_add("export/artifact_sections", len(sections))
+    telemetry.counter_add("export/exported_fns", n_fns)
+    log.info("Exported forest artifact to %s: %d bytes, %d sections, "
+             "layouts %s, buckets %s, fingerprint %s", path, nbytes,
+             len(sections), modes, ladder, fingerprint[:12])
+    return {"path": path, "bytes": nbytes, "sections": len(sections),
+            "layouts": modes, "buckets": ladder,
+            "fingerprint": fingerprint}

@@ -146,6 +146,33 @@ class ModelRegistry:
         self._mirror_gauges()
         return record
 
+    def publish_from_artifact(self, name: str, path: str,
+                              params: Optional[Dict[str, Any]] = None,
+                              warmup_rows: Optional[int] = None,
+                              expect_fingerprint: Optional[str] = None
+                              ) -> Dict[str, Any]:
+        """Publish an exported forest artifact (lightgbm_tpu/export)
+        under `name` — the horizontal scale-out path: a replica that
+        never imports the training stack loads the artifact and gets
+        the same warm-then-swap, budget-accounted treatment as a live
+        booster. The loaded model's deserialized executables live in a
+        real CompiledForest, so the registry's byte budget evicts them
+        exactly like compiled stacks, and re-admission reloads from
+        `path` instead of retracing."""
+        from ..export.loader import load_artifact
+        model = load_artifact(path, params=params,
+                              expect_fingerprint=expect_fingerprint)
+        record = self.publish(name, model, warmup_rows=warmup_rows)
+        record["artifact_path"] = model._path
+        record["artifact_fingerprint"] = model.fingerprint
+        telemetry.counter_add("serving/registry_artifact_publishes", 1)
+        recorder = telemetry.active_recorder()
+        if recorder is not None:
+            recorder.event("artifact_published", name=name,
+                           path=model._path,
+                           fingerprint=model.fingerprint)
+        return record
+
     def publish_many(self, boosters, warmup_rows: Optional[int] = None
                      ) -> List[Dict[str, Any]]:
         """Publish a batch of models — a finished sweep's fleet
@@ -494,6 +521,13 @@ class ModelRegistry:
                 ps["breaker"] = e.breaker.stats()
             if e.bucket is not None:
                 ps["qps_limit"] = e.bucket.rate
+            # artifact-backed entries (publish_from_artifact) carry
+            # their provenance so operators can match a replica's
+            # resident forest to the artifact it was packed from
+            art = getattr(e.gbdt, "_path", None)
+            if art is not None and getattr(e.gbdt, "fingerprint", None):
+                ps["artifact_path"] = art
+                ps["artifact_fingerprint"] = e.gbdt.fingerprint
             out["models"][e.name] = ps
         self._mirror_gauges()
         return out

@@ -207,6 +207,16 @@ DESCRIPTIONS = {
     "tpu_predict_micro_batch_window_ms": "how long submit() waits for "
                                          "co-arriving rows before "
                                          "dispatching the micro-batch",
+    "tpu_export_dir": "directory to write a self-contained exported-"
+                      "forest artifact (StableHLO via jax.export) after "
+                      "training; serving replicas load it without the "
+                      "training stack (empty = no export)",
+    "tpu_export_layouts": "comma-separated quantized layouts packed "
+                          "alongside f32 in the artifact (e.g. "
+                          "\"f16,int8\"; \"none\" = f32 only)",
+    "tpu_export_buckets": "number of power-of-two row buckets exported "
+                          "per layout, starting at "
+                          "tpu_predict_bucket_min",
     "use_missing": "handle NaN/missing specially (false = plain values)",
     "zero_as_missing": "treat zeros as missing (sparse semantics)",
     "sparse_threshold": "column sparsity above which EFB treats the "

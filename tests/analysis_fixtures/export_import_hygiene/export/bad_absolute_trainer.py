@@ -1,0 +1,9 @@
+"""Positive fixture: absolute imports of trainer packages from export/."""
+import lightgbm_tpu.boosting.gbdt  # finding: boosting trainer
+from lightgbm_tpu.learner import histogram  # finding: tree learner
+
+
+def repack(model):
+    # lazy import is still a coupling — it executes on the serving path
+    from lightgbm_tpu.ingest import stream  # finding: ingest stack
+    return stream, histogram, lightgbm_tpu.boosting.gbdt, model

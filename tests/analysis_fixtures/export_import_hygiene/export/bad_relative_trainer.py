@@ -1,0 +1,8 @@
+"""Positive fixture: relative imports reaching the trainer from export/."""
+from ..parallel import collective  # finding: distributed-training stack
+from .. import engine  # finding: front door to the full trainer
+
+
+def load(path):
+    from ..basic import Booster  # finding: Booster imports the trainer
+    return Booster(model_file=path), collective, engine

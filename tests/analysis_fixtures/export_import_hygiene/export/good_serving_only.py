@@ -1,0 +1,21 @@
+"""Negative fixture: the allowed import surface for export/ modules."""
+import json
+import os
+
+import numpy as np
+
+from . import loader
+from .loader import ArtifactModel
+from .. import log, telemetry
+from ..config import Config
+from ..ops import predict as predict_ops
+from ..serving.forest import CompiledForest
+from ..serving.predictor import Predictor
+
+
+def serve(path):
+    import jax
+    from jax import export as jax_export
+    cfg = Config.from_params({})
+    return (json, os, np, loader, ArtifactModel, log, telemetry,
+            predict_ops, CompiledForest, Predictor, jax, jax_export, cfg)

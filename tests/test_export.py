@@ -1,0 +1,347 @@
+"""Exported-forest artifacts: the training-stack-free serving contract.
+
+The contract under test (ISSUE 16): an artifact packed by
+`export.write_artifact` and rehydrated by `export.load_artifact`
+serves predictions BYTE-FOR-BYTE identical to the in-process booster
+across the full matrix (binary / multiclass / categorical /
+NaN-missing data x f32 / f16 / int8 layouts x >=2 ladder buckets),
+loaders refuse corrupted / version-skewed / stale artifacts with the
+offending section named, the serving registry budget-accounts
+artifact-backed entries like compiled stacks (evict frees, re-admit
+reloads from the path), and an import-blocked child — the real
+serving-replica shape — loads an artifact with the trainer absent.
+
+Read-only tests share module-scoped boosters + packed artifacts
+(tier-1 runs under a fixed wall-clock budget); tests that mutate files
+copy them first.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.export import (ArtifactError, FORMAT_VERSION,
+                                 is_artifact, load_artifact,
+                                 read_manifest, write_artifact)
+
+MODES = ("none", "f16", "int8")
+# a 2-step ladder keeps the matrix's jax.export tracing inside the
+# tier-1 wall-clock budget while still covering >=2 buckets AND the
+# chunked >ladder-top path (96-row requests split into 32-row chunks)
+_BASE = {"verbose": -1, "num_leaves": 15, "min_data_in_leaf": 5,
+         "seed": 7, "tpu_export_buckets": 2, "num_boost_round_": 12}
+
+
+def _dataset(kind, n=400, f=8, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, f).astype(np.float32)
+    params = {k: v for k, v in _BASE.items() if k != "num_boost_round_"}
+    if kind == "binary" or kind == "nan":
+        y = (X[:, 0] + X[:, 1] * X[:, 2] > 0.6).astype(np.float32)
+        params["objective"] = "binary"
+    elif kind == "multiclass":
+        y = np.argmax(X[:, :3], axis=1).astype(np.float32)
+        params.update(objective="multiclass", num_class=3)
+    elif kind == "categorical":
+        X[:, 0] = rng.randint(0, 8, size=n).astype(np.float32)
+        y = (np.isin(X[:, 0], (1, 3, 6)) ^ (X[:, 1] > 0.5)) \
+            .astype(np.float32)
+        params.update(objective="binary", categorical_feature=[0])
+    else:  # pragma: no cover
+        raise AssertionError(kind)
+    if kind == "nan":
+        X[rng.rand(n, f) < 0.1] = np.nan
+    return X, y, params
+
+
+def _predict_rows(kind, seed=99, n=96):
+    X, _, _ = _dataset(kind, n=max(n, 128), f=8, seed=seed)
+    return X[:n]
+
+
+@pytest.fixture(scope="module")
+def packed(tmp_path_factory):
+    """kind -> (booster, artifact_path, predict_rows); artifacts carry
+    all three layouts and the default 4-step bucket ladder."""
+    root = tmp_path_factory.mktemp("export_artifacts")
+    out = {}
+    for kind in ("binary", "multiclass", "categorical", "nan"):
+        X, y, params = _dataset(kind)
+        ds = lgb.Dataset(X, y, params=dict(params))
+        booster = lgb.train(dict(params), ds,
+                            num_boost_round=_BASE["num_boost_round_"],
+                            verbose_eval=False)
+        path = str(root / ("%s.artifact" % kind))
+        booster.export_forest(path, layouts=list(MODES),
+                              calibration=X[:256])
+        out[kind] = (booster, path, _predict_rows(kind))
+    return out
+
+
+def _mode_clone(booster, mode):
+    """In-process bit-identity reference for a quantized layout."""
+    return lgb.Booster(model_str=booster.model_to_string(),
+                      params={"tpu_predict_quantize": mode,
+                              "verbose": -1})
+
+
+# ---------------------------------------------------------------------------
+# round-trip bit-identity matrix
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("mode", MODES)
+@pytest.mark.parametrize("kind",
+                         ("binary", "multiclass", "categorical", "nan"))
+def test_round_trip_bit_identity(packed, kind, mode):
+    booster, path, Xt = packed[kind]
+    ref = _mode_clone(booster, mode)
+    model = load_artifact(path, params={"tpu_predict_quantize": mode})
+    # >=2 ladder buckets: 16-row and 96-row requests land in different
+    # exported programs
+    for rows in (Xt[:16], Xt):
+        assert np.array_equal(ref.predict(rows), model.predict(rows))
+        assert np.array_equal(ref.predict(rows, raw_score=True),
+                              model.predict(rows, raw_score=True))
+
+
+def test_round_trip_via_serving_predictor(packed):
+    """artifact == in-process Predictor byte-for-byte, through the
+    full serving front end (bucketing, chunk loop, micro-batching)."""
+    from lightgbm_tpu.serving import Predictor
+    booster, path, Xt = packed["binary"]
+    ref = booster.serving_predictor()
+    pred = Predictor(load_artifact(path))
+    try:
+        assert np.array_equal(ref.predict(Xt), pred.predict(Xt))
+        assert float(ref.predict_one(Xt[0])) == \
+            float(pred.predict_one(Xt[0]))
+    finally:
+        pred.close()
+        ref.close()
+
+
+def test_manifest_shape(packed):
+    _, path, _ = packed["multiclass"]
+    man = read_manifest(path)
+    assert man["format"] == FORMAT_VERSION
+    assert man["forest"]["num_class"] == 3
+    assert sorted(man["layouts"]) == sorted(MODES)
+    assert len(man["buckets"]) >= 2
+    assert man["buckets"] == sorted(man["buckets"])
+    # replica warmup is frozen to the exported ladder top
+    assert man["io_params"]["tpu_predict_warmup_rows"] == \
+        man["buckets"][-1]
+    assert man["fingerprint"]
+
+
+def test_engine_auto_export_hook(tmp_path):
+    """tpu_export_dir at train time publishes the artifact as a side
+    effect of `train()`, and it round-trips."""
+    X, y, params = _dataset("binary", n=300)
+    params["tpu_export_dir"] = str(tmp_path)
+    ds = lgb.Dataset(X, y, params=dict(params))
+    booster = lgb.train(dict(params), ds, num_boost_round=6,
+                        verbose_eval=False)
+    path = tmp_path / "forest.artifact"
+    assert is_artifact(str(path))
+    model = load_artifact(str(path))
+    assert np.array_equal(booster.predict(X[:32]), model.predict(X[:32]))
+
+
+# ---------------------------------------------------------------------------
+# refusal: corruption, version skew, staleness, frozen caps
+# ---------------------------------------------------------------------------
+def test_corrupted_section_refused(packed, tmp_path):
+    _, path, Xt = packed["binary"]
+    bad = str(tmp_path / "corrupt.artifact")
+    blob = open(path, "rb").read()
+    with open(bad, "wb") as fh:   # flip one payload byte near EOF
+        fh.write(blob[:-3] + bytes([blob[-3] ^ 0xFF]) + blob[-2:])
+    with pytest.raises(ArtifactError, match=r"checksum.*section"):
+        load_artifact(bad).predict(Xt[:16])
+
+
+def test_truncated_artifact_refused(packed, tmp_path):
+    _, path, _ = packed["binary"]
+    bad = str(tmp_path / "truncated.artifact")
+    with open(bad, "wb") as fh:
+        fh.write(open(path, "rb").read()[:40])
+    with pytest.raises(ArtifactError):
+        load_artifact(bad)
+
+
+def test_version_skew_refused(packed, tmp_path):
+    _, path, _ = packed["binary"]
+    skew = str(tmp_path / "skew.artifact")
+    blob = open(path, "rb").read()
+    patched = blob.replace(b'"format": %d,' % FORMAT_VERSION,
+                           b'"format": 9,', 1)
+    assert patched != blob
+    with open(skew, "wb") as fh:
+        fh.write(patched)
+    with pytest.raises(ArtifactError, match="format"):
+        load_artifact(skew)
+
+
+def test_stale_fingerprint_refused(packed):
+    """Retrained-since-packing detection: the deployed fingerprint no
+    longer matches the one frozen into the artifact."""
+    _, path, _ = packed["binary"]
+    with pytest.raises(ArtifactError, match="fingerprint"):
+        load_artifact(path, expect_fingerprint="0" * 16)
+    # and the happy path: the artifact's own fingerprint is accepted
+    man = read_manifest(path)
+    assert load_artifact(
+        path, expect_fingerprint=man["fingerprint"]) is not None
+
+
+def test_text_model_is_not_an_artifact(packed, tmp_path):
+    booster, _, _ = packed["binary"]
+    txt = str(tmp_path / "model.txt")
+    booster.save_model(txt)
+    assert not is_artifact(txt)
+    with pytest.raises(ArtifactError, match="not a"):
+        load_artifact(txt)
+
+
+def test_frozen_num_iteration_cap(packed):
+    booster, path, Xt = packed["binary"]
+    model = load_artifact(path)
+    # the packed cap itself ("all", and anything at-or-past it, which
+    # in-process predict would cap the same way) serves fine
+    assert model.predict(Xt[:16], num_iteration=-1).shape == (16,)
+    assert model.predict(
+        Xt[:16], num_iteration=_BASE["num_boost_round_"]).shape == (16,)
+    # a PREFIX of the packed forest would need a fresh stack — frozen
+    with pytest.raises(ArtifactError, match="frozen"):
+        model.predict(Xt[:16], num_iteration=3)
+
+
+def test_trainer_only_predict_modes_refused(packed):
+    _, path, Xt = packed["binary"]
+    model = load_artifact(path)
+    for kw in ("pred_leaf", "pred_contrib", "pred_early_stop"):
+        with pytest.raises(ArtifactError, match="full"):
+            model.predict(Xt[:16], **{kw: True})
+
+
+def test_missing_layout_refused(packed, tmp_path):
+    """An artifact packed without int8 refuses int8 serving by name
+    instead of silently falling back to f32."""
+    booster, _, _ = packed["binary"]
+    path = str(tmp_path / "f32only.artifact")
+    write_artifact(booster, path, layouts=["none"])
+    model = load_artifact(path,
+                          params={"tpu_predict_quantize": "int8"})
+    with pytest.raises(ArtifactError, match="int8"):
+        model.predict(_predict_rows("binary")[:16])
+
+
+# ---------------------------------------------------------------------------
+# registry integration: budget accounting, evict, re-admit
+# ---------------------------------------------------------------------------
+def test_registry_publish_evict_readmit(packed):
+    from lightgbm_tpu.serving import ModelRegistry
+    booster, path, Xt = packed["binary"]
+    ref = booster.predict(Xt)
+    reg = ModelRegistry(warmup_rows=16)
+    try:
+        reg.publish_from_artifact("art", path)
+        assert np.array_equal(reg.predict("art", Xt), ref)
+        stats = reg.stats()["models"]["art"]
+        assert stats["artifact_path"] == path
+        bytes_before = stats["stack_bytes"]
+        assert bytes_before > 0
+
+        # eviction drops the deserialized executables from the budget
+        model = reg._models["art"].gbdt
+        freed = model._forest_cache().evict_entries()
+        assert freed == bytes_before
+        assert model.compiled_stack_bytes() == 0
+
+        # re-admission reloads from the artifact path, bit-identically
+        assert np.array_equal(reg.predict("art", Xt), ref)
+        assert model.compiled_stack_bytes() == bytes_before
+    finally:
+        reg.close()
+
+
+def test_export_telemetry_counters(packed, tmp_path):
+    from lightgbm_tpu import telemetry
+    booster, _, Xt = packed["binary"]
+    telemetry.enable(True)
+    telemetry.reset()
+    try:
+        path = str(tmp_path / "telemetry.artifact")
+        write_artifact(booster, path, layouts=["none"])
+        load_artifact(path).predict(Xt[:16])
+        snap = telemetry.registry().snapshot()
+        counters = {c["name"] for c in snap["counters"]}
+        assert "export/artifact_bytes" in counters
+        assert "export/artifact_sections" in counters
+        assert "export/loads" in counters
+        assert "export/entry_loads" in counters
+    finally:
+        telemetry.enable(False)
+        telemetry.reset()
+
+
+# ---------------------------------------------------------------------------
+# the serving-replica shape: import-blocked child
+# ---------------------------------------------------------------------------
+_CHILD = textwrap.dedent("""
+    import json, sys
+    BLOCKED = ("lightgbm_tpu.boosting", "lightgbm_tpu.learner",
+               "lightgbm_tpu.ingest", "lightgbm_tpu.parallel",
+               "lightgbm_tpu.basic", "lightgbm_tpu.engine",
+               "lightgbm_tpu.dataset", "lightgbm_tpu.cli",
+               "lightgbm_tpu.sklearn", "lightgbm_tpu.objectives")
+
+    class Blocker:
+        def find_spec(self, name, path=None, target=None):
+            for b in BLOCKED:
+                if name == b or name.startswith(b + "."):
+                    raise ImportError("blocked: " + name)
+            return None
+
+    sys.meta_path.insert(0, Blocker())
+    import numpy as np
+    from lightgbm_tpu.export.runtime import ArtifactServer
+    server = ArtifactServer(sys.argv[1], warmup_rows=0)
+    X = np.load(sys.argv[2])
+    out = server.predict(X)
+    loaded = sorted(m for m in sys.modules
+                    if any(m == b or m.startswith(b + ".")
+                           for b in BLOCKED))
+    print(json.dumps({"pred": [float(v) for v in out],
+                      "trainer_modules": loaded}))
+""")
+
+
+def test_import_blocked_child_serves(packed, tmp_path):
+    booster, path, Xt = packed["binary"]
+    rows = str(tmp_path / "rows.npy")
+    np.save(rows, Xt[:16])
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["LIGHTGBM_TPU_COMPILE_CACHE"] = "0"
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+        + env.get("PYTHONPATH", "").split(os.pathsep))
+    res = subprocess.run([sys.executable, "-c", _CHILD, path, rows],
+                         env=env, capture_output=True, text=True,
+                         timeout=300)
+    assert res.returncode == 0, res.stdout + res.stderr
+    line = next(ln for ln in reversed(res.stdout.splitlines())
+                if ln.startswith("{"))
+    child = json.loads(line)
+    assert child["trainer_modules"] == []
+    assert np.array_equal(np.asarray(child["pred"], np.float64),
+                          booster.predict(Xt[:16]))

@@ -76,6 +76,7 @@ FLAT_RULES = {
     "serving-lock": "serving_lock",
     "future-guard": "future_guard",
     "stdout-print": "stdout_print",
+    "export-import-hygiene": "export_import_hygiene",
 }
 
 
