@@ -28,6 +28,12 @@ BENCH_SHAPE=export runs the exported-forest artifact gate
 corruption/version-skew/fingerprint refusal, and an import-blocked
 child serving the artifact with the training stack absent, zero
 steady-state retraces — commits EXPORT_r01.json).
+BENCH_SHAPE=chaos runs the storage-fault-tolerance gate
+(scripts/storage_chaos_smoke.py: training completes byte-identically
+under injected checkpoint EIO/torn-write/slow-rename, run-log and
+heartbeat write failures degrade to counted drops, and the ENOSPC
+oldest-snapshot eviction hatch lands a save on a "full" disk —
+commits CHAOS_r01.json).
 BENCH_SHAPE=elastic runs the kill->shrink->resume supervisor cycle
 (scripts/elastic_smoke.py: rank killed at W=4, wedged collective
 detected by the watchdog, elastic resume at W'=2 then W'=1,
@@ -1154,6 +1160,20 @@ def run_overload() -> dict:
         if os.environ.get("BENCH_ALLOW_CPU") == "1" else None)
 
 
+def run_chaos() -> dict:
+    """Storage-fault-tolerance gate (BENCH_SHAPE=chaos): run the
+    durable-IO chaos smoke headlessly and commit the machine-readable
+    artifact (CHAOS_r01.json: byte-identity under injected
+    EIO/torn/slow-IO, per-stream degradation counts, ENOSPC eviction
+    hatch). The parent never touches a backend — both training runs and
+    the hatch stage live in their own CPU-pinned children."""
+    return _run_smoke_gate(
+        "storage_chaos_smoke.py",
+        os.environ.get("BENCH_CHAOS_OUT",
+                       os.path.join(REPO, "CHAOS_r01.json")),
+        "BENCH_CHAOS_TIMEOUT", "storage_chaos_byte_identity")
+
+
 def run_export() -> dict:
     """Exported-forest gate (BENCH_SHAPE=export): run the artifact
     round-trip / refusal / import-blocked-cold-serve smoke headlessly
@@ -1206,6 +1226,10 @@ def main():
         return
     if which == "export":
         print(json.dumps(run_export()), flush=True)
+        return
+    if which == "chaos":
+        # storage chaos: same parent-never-touches-a-backend discipline
+        print(json.dumps(run_chaos()), flush=True)
         return
     _init_backend_with_retry()
     if which == "amortized":

@@ -19,6 +19,7 @@ from .config_hygiene import ConfigHygieneRule
 from .serving_locks import FutureGuardRule, ServingLockRule
 from .stdout_print import StdoutPrintRule
 from .export_hygiene import ExportImportHygieneRule
+from .durable_write import DurableWriteRule
 
 RULE_CLASSES = (
     PaddedRngRule,
@@ -29,6 +30,7 @@ RULE_CLASSES = (
     FutureGuardRule,
     StdoutPrintRule,
     ExportImportHygieneRule,
+    DurableWriteRule,
 )
 
 

@@ -1,0 +1,69 @@
+"""Rule `durable-write`: the atomic-publish idiom lives in durable.py.
+
+The storage-fault-tolerance PR centralized every durable write (tmp +
+fsync + os.replace) behind `lightgbm_tpu/durable.py`, which adds the
+retry policy, the per-stream criticality split, the ENOSPC eviction
+hatch, and the fault-injection sites. A raw re-implementation anywhere
+else silently escapes ALL of that: it neither retries transient EIO nor
+shows up in the chaos gate, so the next disk hiccup kills a run the
+durable layer would have saved.
+
+This rule freezes the invariant: the low-level publish primitives —
+`os.replace`, `os.rename`, `os.fsync`, `tempfile.mkstemp`,
+`tempfile.NamedTemporaryFile` — may not be called from `lightgbm_tpu/`
+modules other than `durable.py` itself. Route the write through
+`durable.atomic_write_bytes/_text/_via` (critical streams) or
+`durable.best_effort_write_text` (narration/liveness streams) instead.
+
+Scope: files under a `lightgbm_tpu` package directory. Scripts and
+tests own their tmp-file hygiene (harness children intentionally
+exercise raw IO); plain `open(..., "w")` stays legal everywhere — user
+output files are not durable state.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from ..core import Finding, Rule, SourceFile
+
+PACKAGE_SEGMENT = "lightgbm_tpu"
+EXEMPT_BASENAMES = {"durable.py"}
+
+#: module -> attribute names whose call is a raw publish primitive
+_BANNED = {
+    "os": {"replace", "rename", "fsync"},
+    "tempfile": {"mkstemp", "NamedTemporaryFile"},
+}
+
+
+class DurableWriteRule(Rule):
+    name = "durable-write"
+    description = ("raw atomic-publish primitives (os.replace/os.rename/"
+                   "os.fsync/tempfile.mkstemp) outside durable.py "
+                   "(route through the durable layer)")
+
+    def check_file(self, src: SourceFile) -> Iterable[Finding]:
+        out: List[Finding] = []
+        parts = src.display_path.split("/")
+        if PACKAGE_SEGMENT not in parts[:-1]:
+            return out
+        if parts[-1] in EXEMPT_BASENAMES:
+            return out
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if not (isinstance(func, ast.Attribute)
+                    and isinstance(func.value, ast.Name)):
+                continue
+            banned = _BANNED.get(func.value.id)
+            if banned is None or func.attr not in banned:
+                continue
+            out.append(src.finding(
+                self.name, node,
+                "raw %s.%s inside lightgbm_tpu/: durable-state publishes "
+                "must route through durable.atomic_write_* (retry policy, "
+                "criticality split, ENOSPC hatch and fault-injection "
+                "sites all live there)" % (func.value.id, func.attr)))
+        return out

@@ -403,6 +403,12 @@ class GBDT:
         rank = jax.process_index()
         self._process_rank = rank
         hb_dir = net.tpu_heartbeat_dir
+        # durable-IO retry policy for every storage write this run makes
+        # (checkpoint snapshots, caches, artifacts, telemetry sinks)
+        from .. import durable
+        durable.configure(retries=self.config.io.tpu_io_retries,
+                          backoff_s=self.config.io.tpu_io_backoff_s,
+                          deadline_s=self.config.io.tpu_io_deadline_s)
         watchdog.configure(
             timeout_s=net.tpu_collective_timeout_s,
             failure_dir=hb_dir or None,

@@ -38,12 +38,11 @@ import hashlib
 import json
 import os
 import re
-import tempfile
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from . import log
+from . import durable, log
 from .testing import faults
 
 FORMAT_VERSION = 1
@@ -101,6 +100,11 @@ _FINGERPRINT_EXCLUDE = {
     # already-trained forest for serving replicas — which layouts and
     # buckets get packed never feeds back into training numerics
     "tpu_export_dir", "tpu_export_layouts", "tpu_export_buckets",
+    # durable-IO retry policy (ISSUE 18, lightgbm_tpu/durable.py):
+    # retries/backoff/deadline decide whether a run SURVIVES writing
+    # its state, never what that state is — a resumed run may harden
+    # or relax its storage policy freely
+    "tpu_io_retries", "tpu_io_backoff_s", "tpu_io_deadline_s",
     "output_model", "output_result", "input_model", "convert_model",
     "config_file", "machine_list_file", "snapshot_freq", "verbose",
     "metric_freq", "num_iterations", "num_threads", "task",
@@ -136,44 +140,24 @@ class CheckpointError(log.LightGBMError):
 
 
 # ---------------------------------------------------------------------------
-# atomic file IO
+# atomic file IO — the implementation moved to lightgbm_tpu/durable.py
+# (ISSUE 18), which adds retry/backoff/deadline and the criticality
+# policy on top of the same tmp+fsync+rename publish. These wrappers
+# stay as the historical import surface; the "checkpoint.*" injection
+# sites keep their names (`checkpoint.write` / `checkpoint.rename`).
 # ---------------------------------------------------------------------------
-def atomic_write_bytes(path: str, data: bytes) -> None:
-    """Write `data` to `path` crash-consistently: a same-directory tmp
-    file is written and fsync'd, then atomically renamed over the target
-    (so an interrupt leaves either the old file or the new one, never a
-    truncated hybrid), then the directory entry is fsync'd."""
-    directory = os.path.dirname(os.path.abspath(path))
-    faults.inject("checkpoint.write")
-    fd, tmp_path = tempfile.mkstemp(
-        dir=directory, prefix=os.path.basename(path) + ".tmp.")
-    try:
-        with os.fdopen(fd, "wb") as fh:
-            fh.write(data)
-            fh.flush()
-            os.fsync(fh.fileno())
-        faults.inject("checkpoint.rename")
-        os.replace(tmp_path, path)
-    except BaseException:
-        try:
-            os.unlink(tmp_path)
-        except OSError:
-            pass
-        raise
-    # persist the rename itself (POSIX: directory fsync); best-effort on
-    # filesystems that refuse O_RDONLY directory fds
-    try:
-        dfd = os.open(directory, os.O_RDONLY)
-        try:
-            os.fsync(dfd)
-        finally:
-            os.close(dfd)
-    except OSError:  # pragma: no cover
-        pass
+def atomic_write_bytes(path: str, data: bytes, site: str = "checkpoint",
+                       **kw) -> None:
+    """Write `data` to `path` crash-consistently (same-dir tmp + fsync +
+    atomic rename + directory fsync), retrying transient storage faults
+    per the durable-IO policy; raises `durable.DurableWriteError` when
+    the budget is exhausted."""
+    durable.atomic_write_bytes(path, data, site=site, **kw)
 
 
-def atomic_write_text(path: str, text: str) -> None:
-    atomic_write_bytes(path, text.encode("utf-8"))
+def atomic_write_text(path: str, text: str, site: str = "checkpoint",
+                      **kw) -> None:
+    durable.atomic_write_text(path, text, site=site, **kw)
 
 
 # ---------------------------------------------------------------------------
@@ -390,17 +374,45 @@ class CheckpointManager:
 
     # -- write ----------------------------------------------------------
     def save(self, payload: Dict[str, Any], iteration: int) -> str:
+        """Durably publish one snapshot, THEN rotate. The ordering is
+        the crash-safety invariant: old snapshots are deleted only
+        after the new one is fully durable (fsync'd + renamed), so a
+        save that dies anywhere mid-write leaves the previous newest
+        snapshot loadable. On ENOSPC the oldest prunable snapshot is
+        evicted (never the newest durable one) and the write retried
+        once — the escape hatch for a checkpoint directory that filled
+        up under keep_last pressure."""
         data = json.dumps(payload, sort_keys=True,
                           separators=(",", ":")).encode("utf-8")
         header = (f"LGBMTPU-CKPT/{FORMAT_VERSION} "
                   f"sha256={hashlib.sha256(data).hexdigest()} "
                   f"bytes={len(data)}\n").encode("ascii")
         path = self.path_for(iteration)
-        atomic_write_bytes(path, header + data)
+        durable.atomic_write_bytes(path, header + data, site="checkpoint",
+                                   on_enospc=self._evict_for_space)
         self._rotate()
         return path
 
+    def _evict_for_space(self) -> bool:
+        """ENOSPC escape hatch: free the OLDEST prunable snapshot of
+        this rank's series. The newest durable snapshot is never a
+        candidate — it is the state a preempted run resumes from."""
+        snaps = self.snapshots()
+        for _, path in snaps[:-1]:
+            try:
+                os.unlink(path)
+            except OSError:  # already gone / unremovable: try the next
+                continue
+            log.warning("Checkpoint save hit ENOSPC; evicted oldest "
+                        "snapshot %s to retry", path)
+            return True
+        return False
+
     def _rotate(self) -> None:
+        # runs ONLY after the new snapshot is fully durable (see save);
+        # the injection site lets tests kill a run in the write->rotate
+        # window and prove both neighbors stay loadable
+        faults.inject("checkpoint.rotate")
         snaps = self.snapshots()
         for _, path in snaps[:-self.keep_last]:
             try:
@@ -442,10 +454,13 @@ class CheckpointManager:
         return self.load(self.path_for(iteration))
 
     def load_latest(self) -> Optional[Tuple[Dict[str, Any], str]]:
-        """Newest snapshot that validates; corrupt ones are skipped with
-        a warning (crash-mid-write leaves either no file or, with a
-        non-atomic filesystem, a file this rejects — the previous
-        snapshot then restores a slightly older but consistent state)."""
+        """Newest snapshot that validates; corrupt ones are QUARANTINED
+        (renamed `*.corrupt`, pruned keep-last-1) and skipped with a
+        warning — crash-mid-write leaves either no file or, with a
+        non-atomic filesystem, a file this rejects; the previous
+        snapshot then restores a slightly older but consistent state,
+        and the quarantine keeps the bad bytes from being re-validated
+        on every later resume."""
         for iteration, path in reversed(self.snapshots()):
             try:
                 return self.load(path), path
@@ -453,6 +468,9 @@ class CheckpointManager:
                 log.warning("Skipping unusable checkpoint %s (%s); "
                             "falling back to the previous snapshot",
                             path, exc)
+                if isinstance(exc, CheckpointError):
+                    durable.quarantine(path, reason="checkpoint failed "
+                                       "validation")
         return None
 
 

@@ -137,9 +137,10 @@ def _build_dataset(path: str, params: Dict, cfg: Config,
                               num_global_rows=inner.num_global_rows)
     bin_path = _check_binary_dataset(path) \
         if cfg.io.enable_load_from_binary_file else None
+    ds = None
     if bin_path is not None and reference is None:
         from .dataset import Dataset as InnerDataset
-        from .ingest import CacheMismatch
+        from .ingest import CacheCorrupt, CacheMismatch
         expected = _cache_fingerprint(path, cfg) \
             if bin_path != path else None
         if expected is None and bin_path != path:
@@ -151,9 +152,19 @@ def _build_dataset(path: str, params: Dict, cfg: Config,
         try:
             inner = InnerDataset.load_binary(
                 bin_path, expected_fingerprint=expected)
+            ds = Dataset._from_inner(inner)
         except CacheMismatch as exc:
             log.fatal(str(exc))
-        ds = Dataset._from_inner(inner)
+        except CacheCorrupt as exc:
+            # the corrupt file is already quarantined (*.corrupt); with a
+            # source file present we can re-bin, otherwise there is
+            # nothing to rebuild from
+            if bin_path == path:
+                log.fatal(str(exc))
+            log.warning("%s — rebuilding from %s", exc, path)
+            bin_path = None
+    if ds is not None:
+        pass
     elif cfg.io.use_two_round_loading and reference is None:
         from .parallel.loader import two_round_load
         log.info("Two-round loading %s", path)

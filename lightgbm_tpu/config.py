@@ -143,6 +143,18 @@ class IOConfig:
     tpu_checkpoint_dir: str = ""
     tpu_checkpoint_interval: int = 10
     tpu_checkpoint_keep: int = 3
+    # storage-fault tolerance (lightgbm_tpu/durable.py): every durable
+    # write (checkpoint snapshots, exported artifacts, dataset caches)
+    # retries transient IO errors — tpu_io_retries extra attempts with
+    # exponential backoff starting at tpu_io_backoff_s, the whole write
+    # bounded by tpu_io_deadline_s seconds (0 disables the deadline).
+    # Critical streams raise a structured DurableWriteError on
+    # exhaustion; best-effort telemetry/heartbeat streams drop with a
+    # counter instead. Fingerprint-excluded: IO policy never changes a
+    # model's trajectory
+    tpu_io_retries: int = 2
+    tpu_io_backoff_s: float = 0.05
+    tpu_io_deadline_s: float = 30.0
     # world-size-elastic resume (lightgbm_tpu/checkpoint.py +
     # boosting/gbdt.py): accept a snapshot taken at a different world
     # size (device count and/or process count) — scores are re-sharded
@@ -451,6 +463,10 @@ TPU_PARAM_SPEC = {
     "tpu_checkpoint_interval": ("int", 1, None),
     "tpu_checkpoint_keep": ("int", 1, None),
     "tpu_elastic_resume": "bool",
+    # durable-IO retry policy
+    "tpu_io_retries": ("int", 0, None),
+    "tpu_io_backoff_s": ("float", 0.0, None),
+    "tpu_io_deadline_s": ("float", 0.0, None),
     # telemetry
     "tpu_telemetry_dir": "path",
     "tpu_telemetry": "bool",

@@ -1,0 +1,354 @@
+"""Storage-fault-tolerant durable IO: the one path every durable write
+takes.
+
+The elastic-preemptible-pod story (checkpoints + watchdogs + elastic
+resume) hardened every layer except the filesystem itself: a single
+transient ENOSPC/EIO on shared storage used to kill a training run that
+had just survived a dead rank. This module is the repair — a retrying
+atomic writer with a per-stream criticality policy:
+
+- **critical** streams (checkpoint snapshots, exported-forest
+  artifacts, dataset caches) retry with bounded attempts + exponential
+  backoff under a per-write deadline (`tpu_io_retries` /
+  `tpu_io_backoff_s` / `tpu_io_deadline_s`), then raise a structured
+  `DurableWriteError` naming the path, errno and attempt count;
+- **best-effort** streams (run-log appends, Prometheus dumps,
+  heartbeat leases, watchdog failure evidence) degrade to
+  drop-with-counter plus ONE rate-limited warning — they never raise
+  into the training loop.
+
+Every publish is the same crash-consistent sequence checkpoint.py
+pioneered: same-directory tmp file, write, flush, fsync, atomic rename,
+directory fsync — so a reader observes either the old file or the new
+one, never a hybrid. Fault-injection sites live INSIDE the layer
+(`<site>.write` before the tmp file opens, `<site>.rename` before the
+atomic publish, plus the torn-write probe between body and fsync), so
+`testing/faults.py`'s storage shapes (`enospc`, `eio_write`, `slow_io`,
+`torn_write`) exercise injected and real faults through the same
+except-OSError code path.
+
+ENOSPC escape hatch: a writer may pass `on_enospc`, a callback that
+frees space (the checkpoint manager drops its oldest prunable snapshot
+— never the newest durable one) and earns exactly one extra attempt.
+
+Corrupt files found on READ are `quarantine()`d — renamed `*.corrupt`
+so rebuild/fallback paths get a clean retry on the next run instead of
+refusing forever; stale quarantined siblings are pruned keep-last-1.
+
+graftlint's `durable-write` rule freezes the invariant: the raw
+os.replace/os.fsync/tempfile.mkstemp publish idiom may appear in this
+module only.
+"""
+from __future__ import annotations
+
+import errno as _errno
+import os
+import tempfile
+import time
+from typing import Callable, Dict, Optional
+
+from . import log
+from .testing import faults
+
+# attempts = retries + 1; backoff doubles per retry; the deadline bounds
+# the whole write (a slow-IO stall must not hold a checkpoint hostage
+# past it). Env overrides let supervisor-launched children inherit a
+# policy without plumbing params.
+DEFAULT_RETRIES = 2
+DEFAULT_BACKOFF_S = 0.05
+DEFAULT_DEADLINE_S = 30.0
+
+# one rate-limited warning per best-effort stream: the first drop warns,
+# repeats stay silent for this long (the counter keeps the full tally)
+WARN_INTERVAL_S = 60.0
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, ""))
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, ""))
+    except ValueError:
+        return default
+
+
+_retries = _env_int("LGBM_TPU_IO_RETRIES", DEFAULT_RETRIES)
+_backoff_s = _env_float("LGBM_TPU_IO_BACKOFF_S", DEFAULT_BACKOFF_S)
+_deadline_s = _env_float("LGBM_TPU_IO_DEADLINE_S", DEFAULT_DEADLINE_S)
+
+_dropped: Dict[str, int] = {}       # stream -> writes dropped
+_last_warn: Dict[str, float] = {}   # stream -> monotonic() of last warning
+
+
+class DurableWriteError(log.LightGBMError):
+    """A critical durable write exhausted its retry budget. Carries the
+    structured evidence an operator needs: target path, errno of the
+    last failure, and how many attempts were made."""
+
+    def __init__(self, path: str, site: str, attempts: int,
+                 last_error: Optional[BaseException]):
+        self.path = path
+        self.site = site
+        self.attempts = int(attempts)
+        self.errno = getattr(last_error, "errno", None)
+        name = (_errno.errorcode.get(self.errno, str(self.errno))
+                if self.errno is not None else "unknown")
+        super().__init__(
+            "Durable write to %s failed after %d attempt(s) "
+            "[site=%s errno=%s]: %s"
+            % (path, self.attempts, site, name, last_error))
+
+
+def configure(retries: Optional[int] = None,
+              backoff_s: Optional[float] = None,
+              deadline_s: Optional[float] = None) -> None:
+    """Install the run's retry policy (called by GBDT.init from the
+    tpu_io_* params — fingerprint-excluded: IO policy never changes a
+    model's trajectory, only whether the run survives writing it)."""
+    global _retries, _backoff_s, _deadline_s
+    if retries is not None:
+        _retries = max(0, int(retries))
+    if backoff_s is not None:
+        _backoff_s = max(0.0, float(backoff_s))
+    if deadline_s is not None:
+        _deadline_s = max(0.0, float(deadline_s))
+
+
+def policy() -> Dict[str, float]:
+    return {"retries": _retries, "backoff_s": _backoff_s,
+            "deadline_s": _deadline_s}
+
+
+def dropped(stream: Optional[str] = None):
+    """Drop tally — the whole dict, or one stream's count."""
+    if stream is None:
+        return dict(_dropped)
+    return _dropped.get(stream, 0)
+
+
+def reset_for_tests() -> None:
+    global _retries, _backoff_s, _deadline_s
+    _retries = _env_int("LGBM_TPU_IO_RETRIES", DEFAULT_RETRIES)
+    _backoff_s = _env_float("LGBM_TPU_IO_BACKOFF_S", DEFAULT_BACKOFF_S)
+    _deadline_s = _env_float("LGBM_TPU_IO_DEADLINE_S", DEFAULT_DEADLINE_S)
+    _dropped.clear()
+    _last_warn.clear()
+
+
+def _count(name: str, n: float = 1) -> None:
+    # lazy: telemetry imports stay out of module scope so durable remains
+    # a leaf module (importable from export/ and parallel/ alike)
+    try:
+        from . import telemetry
+        telemetry.counter_add(name, n)
+    except Exception:  # telemetry must never break the write path
+        pass
+
+
+def note_dropped(stream: str, path: str, exc: BaseException,
+                 counter: Optional[str] = None) -> None:
+    """Record one dropped best-effort write: per-stream counter plus a
+    single rate-limited warning (the first drop says so loudly; repeats
+    stay silent for WARN_INTERVAL_S while the counter keeps counting)."""
+    n = _dropped[stream] = _dropped.get(stream, 0) + 1
+    _count(counter or "io/dropped_writes", 1)
+    now = time.monotonic()
+    last = _last_warn.get(stream)
+    if last is not None and now - last < WARN_INTERVAL_S:
+        return
+    _last_warn[stream] = now
+    log.warning(
+        "Best-effort write to %s failed (%s); dropping '%s' stream "
+        "writes (%d dropped so far; this warning is rate-limited)",
+        path, exc, stream, n)
+
+
+# ---------------------------------------------------------------------------
+# the atomic publish (single attempt)
+# ---------------------------------------------------------------------------
+def _publish_once(path: str, write_body: Callable, site: str,
+                  fsync: bool) -> None:
+    """One crash-consistent publish: same-dir tmp + body + flush (+
+    fsync) + atomic rename (+ directory fsync). On ANY failure the tmp
+    file is removed — a reader only ever sees old-or-new."""
+    directory = os.path.dirname(os.path.abspath(path))
+    faults.inject(site + ".write")
+    fd, tmp_path = tempfile.mkstemp(
+        dir=directory, prefix=os.path.basename(path) + ".tmp.")
+    try:
+        with os.fdopen(fd, "wb") as fh:
+            write_body(fh)
+            fh.flush()
+            if faults.take_torn(site):
+                # the torn-write shape: half the payload reaches the tmp
+                # file, then the write "dies". The publish rename never
+                # runs, so no partial TARGET is ever visible — which is
+                # exactly the invariant the shape exists to prove.
+                fh.truncate(max(0, fh.tell() // 2))
+                raise OSError(_errno.EIO, "injected torn write", path)
+            if fsync:
+                os.fsync(fh.fileno())
+        faults.inject(site + ".rename")
+        os.replace(tmp_path, path)
+    except BaseException:
+        try:
+            os.unlink(tmp_path)
+        except OSError:
+            pass
+        raise
+    if not fsync:
+        return
+    # persist the rename itself (POSIX: directory fsync); best-effort on
+    # filesystems that refuse O_RDONLY directory fds
+    try:
+        dfd = os.open(directory, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:  # pragma: no cover
+        pass
+
+
+# ---------------------------------------------------------------------------
+# the retrying writer
+# ---------------------------------------------------------------------------
+def atomic_write_via(path: str, write_body: Callable, *,
+                     site: str = "io", critical: bool = True,
+                     on_enospc: Optional[Callable[[], bool]] = None,
+                     fsync: bool = True, stream: Optional[str] = None,
+                     counter: Optional[str] = None,
+                     retries: Optional[int] = None,
+                     backoff_s: Optional[float] = None,
+                     deadline_s: Optional[float] = None) -> bool:
+    """Durably publish whatever `write_body(fh)` writes, retrying
+    transient OSErrors per the installed policy.
+
+    Returns True on success. On exhaustion: critical streams raise
+    `DurableWriteError`; best-effort streams (`critical=False`) record
+    the drop (`note_dropped`) and return False. `on_enospc` may free
+    space on the first ENOSPC and earns one extra attempt. `fsync=False`
+    is for evidence-not-durability streams (heartbeat leases)."""
+    stream = stream or site
+    r = _retries if retries is None else max(0, int(retries))
+    b = _backoff_s if backoff_s is None else max(0.0, float(backoff_s))
+    d = _deadline_s if deadline_s is None else max(0.0, float(deadline_s))
+    deadline = time.monotonic() + d if d > 0 else None
+    attempts = 0
+    enospc_used = False
+    last: Optional[OSError] = None
+    while True:
+        attempts += 1
+        try:
+            _publish_once(path, write_body, site, fsync)
+            return True
+        except OSError as exc:
+            last = exc
+            if (exc.errno == _errno.ENOSPC and on_enospc is not None
+                    and not enospc_used):
+                # escape hatch: let the caller free space, retry once
+                # for free (outside the normal budget — a full disk is
+                # not a transient fault, and backoff won't fix it)
+                enospc_used = True
+                try:
+                    freed = bool(on_enospc())
+                except Exception as hatch_exc:
+                    log.warning("ENOSPC eviction hook failed: %s",
+                                hatch_exc)
+                    freed = False
+                if freed:
+                    _count("io/enospc_evictions", 1)
+                    continue
+            if attempts > r:
+                break
+            delay = b * (2 ** (attempts - 1))
+            if deadline is not None \
+                    and time.monotonic() + delay > deadline:
+                break
+            _count("io/write_retries", 1)
+            if delay > 0:
+                time.sleep(delay)
+    if critical:
+        raise DurableWriteError(path, site, attempts, last) from last
+    note_dropped(stream, path, last if last is not None
+                 else OSError("unknown"), counter=counter)
+    return False
+
+
+def atomic_write_bytes(path: str, data: bytes, **kw) -> bool:
+    """Crash-consistent `data` -> `path` through the retry policy."""
+    return atomic_write_via(path, lambda fh: fh.write(data), **kw)
+
+
+def atomic_write_text(path: str, text: str, **kw) -> bool:
+    return atomic_write_bytes(path, text.encode("utf-8"), **kw)
+
+
+def best_effort_write_text(path: str, text: str, *, stream: str,
+                           counter: Optional[str] = None,
+                           fsync: bool = False,
+                           retries: int = 0) -> bool:
+    """Best-effort one-shot publish for liveness/narration streams:
+    never raises, never sleeps in a retry loop by default (a heartbeat
+    that backs off is a heartbeat that reads as expired)."""
+    return atomic_write_text(path, text, site=stream, critical=False,
+                             stream=stream, counter=counter, fsync=fsync,
+                             retries=retries)
+
+
+# ---------------------------------------------------------------------------
+# read-side quarantine
+# ---------------------------------------------------------------------------
+def quarantine(path: str, reason: str = "",
+               keep_last: int = 1) -> Optional[str]:
+    """Rename a corrupt file to `<path>.corrupt` so every rebuild /
+    fall-back path gets a clean retry on its next attempt instead of
+    tripping over the same bytes forever. Older quarantined siblings in
+    the directory are pruned keep-last-`keep_last`. Best-effort: returns
+    the quarantine path, or None when the rename itself failed."""
+    qpath = path + ".corrupt"
+    try:
+        os.replace(path, qpath)
+    except OSError as exc:
+        log.warning("Could not quarantine corrupt file %s: %s", path, exc)
+        return None
+    _count("io/quarantined", 1)
+    log.warning("Quarantined corrupt file %s -> %s%s; the next run "
+                "rebuilds from source", path, qpath,
+                " (%s)" % reason if reason else "")
+    prune_quarantined(os.path.dirname(os.path.abspath(path)),
+                      keep_last=keep_last)
+    return qpath
+
+
+def prune_quarantined(directory: str, keep_last: int = 1) -> int:
+    """Remove stale `*.corrupt` files beyond the newest `keep_last`
+    (the newest is kept as post-mortem evidence)."""
+    try:
+        names = [n for n in os.listdir(directory)
+                 if n.endswith(".corrupt")]
+    except OSError:
+        return 0
+    paths = [os.path.join(directory, n) for n in names]
+
+    def _mtime(p):
+        try:
+            return os.path.getmtime(p)
+        except OSError:
+            return 0.0
+
+    paths.sort(key=_mtime)
+    victims = paths[:-keep_last] if keep_last > 0 else paths
+    removed = 0
+    for p in victims:
+        try:
+            os.unlink(p)
+            removed += 1
+        except OSError:  # pragma: no cover
+            pass
+    return removed

@@ -28,7 +28,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from . import MAGIC, FORMAT_VERSION, ArtifactError
-from .. import log, telemetry
+from .. import durable, log, telemetry
 from ..serving.forest import bucket_ladder, bucket_rows, pad_rows
 
 _ALIGN = 64
@@ -353,17 +353,19 @@ def write_artifact(booster, path: str, num_iteration: int = -1,
 
         out_dir = os.path.dirname(os.path.abspath(path))
         os.makedirs(out_dir, exist_ok=True)
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as fh:
+
+        def _body(fh):
             fh.write(MAGIC)
             fh.write(struct.pack("<q", hlen))
             fh.write(blob)
             for d, raw in sections:
                 fh.seek(d["offset"])
                 fh.write(raw)
-            fh.flush()
-            os.fsync(fh.fileno())
-        os.replace(tmp, path)
+
+        # critical stream: a serving replica about to load this artifact
+        # must never observe a half-written file, and a transient IO
+        # fault must not silently skip the export
+        durable.atomic_write_via(path, _body, site="export.artifact")
         nbytes = os.path.getsize(path)
 
     telemetry.counter_add("export/artifact_bytes", nbytes)

@@ -26,7 +26,8 @@ log.
 from __future__ import annotations
 
 from .build import build_from_numpy, build_inner
-from .cache import (CacheMismatch, FORMAT_VERSION as CACHE_FORMAT_VERSION,
+from .cache import (CacheCorrupt, CacheMismatch,
+                    FORMAT_VERSION as CACHE_FORMAT_VERSION,
                     MAGIC as CACHE_MAGIC, binning_params_fingerprint_fields,
                     ingest_fingerprint, load_cache, save_cache)
 from .landing import HostLanding, RowLayout, ShardedLanding, plan_row_layout
@@ -35,7 +36,8 @@ from .sources import (ArraySource, ChunkSource, ChunksSource,
                       DEFAULT_CHUNK_ROWS, FileSource)
 
 __all__ = [
-    "ArraySource", "CacheMismatch", "CACHE_FORMAT_VERSION", "CACHE_MAGIC",
+    "ArraySource", "CacheCorrupt", "CacheMismatch",
+    "CACHE_FORMAT_VERSION", "CACHE_MAGIC",
     "ChunkSource", "ChunksSource", "DEFAULT_CHUNK_ROWS", "FileSource",
     "HostLanding", "RowLayout", "ShardedLanding", "SketchResult",
     "binning_params_fingerprint_fields", "build_from_numpy", "build_inner",

@@ -32,7 +32,7 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
-from .. import log, telemetry
+from .. import durable, log, telemetry
 
 MAGIC = b"lightgbm_tpu.dsetcache.v2\n"
 FORMAT_VERSION = 2
@@ -46,6 +46,14 @@ _ARRAY_FIELDS = ("binned", "label", "weights", "query_boundaries",
 class CacheMismatch(log.LightGBMError):
     """Raised when a cache file's fingerprint does not match what the
     caller was about to build."""
+
+
+class CacheCorrupt(log.LightGBMError):
+    """Raised when a cache file fails validation (checksum, truncation,
+    garbled header). The file has already been QUARANTINED (renamed
+    `*.corrupt`, stale siblings pruned keep-last-1) by the time this
+    propagates, so the caller's rebuild-from-source path gets a clean
+    retry instead of refusing on every subsequent run."""
 
 
 def ingest_fingerprint(source_desc: Optional[Dict[str, Any]],
@@ -149,20 +157,30 @@ def save_cache(inner, path: str, fingerprint: str = "") -> None:
         log.fatal("cache header overflow")
     blob = blob + b" " * (hlen - len(blob))
 
-    tmp = path + ".tmp"
+    def _body(fh):
+        fh.write(MAGIC)
+        fh.write(struct.pack("<q", hlen))
+        fh.write(blob)
+        for d, (_, a) in zip(descs, payloads):
+            fh.seek(d["offset"])
+            fh.write(memoryview(a).cast("B"))
+
     with telemetry.span("ingest/cache_save"):
-        with open(tmp, "wb") as fh:
-            fh.write(MAGIC)
-            fh.write(struct.pack("<q", hlen))
-            fh.write(blob)
-            for d, (_, a) in zip(descs, payloads):
-                fh.seek(d["offset"])
-                fh.write(memoryview(a).cast("B"))
-            fh.flush()
-            os.fsync(fh.fileno())
-        os.replace(tmp, path)
+        # critical stream: a half-written cache would poison every later
+        # run that trusts it — publish atomically, retry transient faults
+        durable.atomic_write_via(path, _body, site="ingest.cache")
     log.info("Saved binary dataset cache to %s (%d arrays, fingerprint "
              "%s)", path, len(descs), fingerprint[:12] or "<none>")
+
+
+def _quarantine_and_raise(path: str, what: str) -> None:
+    """Corrupt cache found on read: rename it `*.corrupt` (pruning stale
+    quarantined siblings keep-last-1) and raise CacheCorrupt so the
+    caller re-bins from source — once, not on every later run."""
+    durable.quarantine(path, reason=what)
+    raise CacheCorrupt(
+        "Dataset cache %s %s; the file was quarantined as %s.corrupt — "
+        "re-binning from the source data" % (path, what, path))
 
 
 def load_cache(path: str, expected_fingerprint: Optional[str] = None,
@@ -173,6 +191,8 @@ def load_cache(path: str, expected_fingerprint: Optional[str] = None,
     built from a different source file or different binning params.
     `mmap_binned`: map the binned matrix read-only instead of copying it
     into RAM (the matrix is only read by training).
+    Corruption (checksum/truncation/garbled header) quarantines the file
+    and raises `CacheCorrupt` so rebuild paths retry cleanly.
     """
     from ..binning import BinMapper
     from ..dataset import Dataset as InnerDataset, Metadata
@@ -183,8 +203,17 @@ def load_cache(path: str, expected_fingerprint: Optional[str] = None,
             if magic != MAGIC:
                 raise log.LightGBMError(
                     "%s is not a lightgbm_tpu v2 dataset cache" % path)
-            (hlen,) = struct.unpack("<q", fh.read(8))
-            header = json.loads(fh.read(hlen).decode())
+            try:
+                (hlen,) = struct.unpack("<q", fh.read(8))
+                if hlen <= 0 or hlen > os.path.getsize(path):
+                    # bit-flipped length field: reading it would try to
+                    # allocate garbage-sized buffers
+                    raise ValueError(
+                        "implausible header length %d" % hlen)
+                header = json.loads(fh.read(hlen).decode())
+            except (struct.error, ValueError, UnicodeDecodeError) as exc:
+                _quarantine_and_raise(
+                    path, "has a garbled header (%s)" % exc)
         if int(header.get("format", 0)) > FORMAT_VERSION:
             raise log.LightGBMError(
                 "Dataset cache %s has format %s; this build supports <= %d"
@@ -228,23 +257,29 @@ def load_cache(path: str, expected_fingerprint: Optional[str] = None,
                 shape = tuple(int(s) for s in d["shape"])
                 dtype = np.dtype(d["dtype"])
                 if name == "binned" and mmap_binned:
-                    arr = np.memmap(path, dtype=dtype, mode="r",
-                                    offset=int(d["offset"]), shape=shape)
+                    try:
+                        arr = np.memmap(path, dtype=dtype, mode="r",
+                                        offset=int(d["offset"]),
+                                        shape=shape)
+                    except ValueError as exc:  # file shorter than shape
+                        _quarantine_and_raise(
+                            path, "is truncated (array %s: %s)"
+                            % (name, exc))
                     crc = _crc(arr)
                 else:
                     fh.seek(int(d["offset"]))
                     raw = fh.read(int(d["nbytes"]))
                     if len(raw) != int(d["nbytes"]):
-                        raise log.LightGBMError(
-                            "Dataset cache %s is truncated (array %s)"
-                            % (path, name))
+                        _quarantine_and_raise(
+                            path, "is truncated (array %s)" % name)
                     crc = zlib.crc32(raw) & 0xFFFFFFFF
                     arr = np.frombuffer(raw, dtype=dtype).reshape(shape)
                 if crc != int(d["crc32"]):
-                    raise log.LightGBMError(
-                        "Dataset cache %s failed its checksum (array %s); "
-                        "the file is corrupt — delete it to re-bin"
-                        % (path, name))
+                    # release the memmap before the quarantine rename:
+                    # some platforms refuse to move a mapped file
+                    arr = None
+                    _quarantine_and_raise(
+                        path, "failed its checksum (array %s)" % name)
                 arrays[name] = arr
 
         ds.binned = arrays.get("binned")

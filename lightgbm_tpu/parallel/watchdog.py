@@ -176,12 +176,18 @@ def _expire(site: str, timeout_s: float, iteration: Optional[int]) -> None:
     if directory:
         try:
             os.makedirs(directory, exist_ok=True)
-            path = failure_path(directory, rank)
-            with open(path + ".tmp", "w") as fh:
-                json.dump(record, fh)
-            os.replace(path + ".tmp", path)
         except OSError:
-            pass
+            directory = ""
+    if directory:
+        # best-effort through the durable layer (counted, rate-limited
+        # warning): the process is about to exit with RC_RANK_FAILURE
+        # either way, but the evidence write should survive a transient
+        # fault if any attempt can
+        from .. import durable
+        durable.atomic_write_text(
+            failure_path(directory, rank), json.dumps(record),
+            site="watchdog.failure", critical=False,
+            stream="watchdog.failure")
     # structured run-log event: best-effort — the evidence file above
     # is the primary artifact. The heartbeat file is deliberately NOT
     # touched: it must keep the rank's last PROGRESS beat, so

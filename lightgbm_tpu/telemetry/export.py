@@ -116,13 +116,23 @@ def prometheus_text(snapshot: Dict[str, Any],
 
 def write_prometheus(path: str,
                      extra_labels: Optional[Dict[str, str]] = None,
-                     snapshot: Optional[Dict[str, Any]] = None) -> str:
-    """Atomically write the (current) registry as Prometheus text."""
-    from .. import checkpoint as ckpt
+                     snapshot: Optional[Dict[str, Any]] = None
+                     ) -> Optional[str]:
+    """Atomically write the (current) registry as Prometheus text.
+
+    Best-effort stream: a dump that cannot land (disk full, telemetry
+    dir on a dead mount) is dropped into the
+    `telemetry/prom_write_errors` counter instead of raising — metrics
+    narration never takes down the run it narrates. Returns the path on
+    success, None when the write was dropped."""
+    from .. import durable
     snap = snapshot if snapshot is not None \
         else metrics_mod.registry().snapshot()
-    ckpt.atomic_write_text(path, prometheus_text(snap, extra_labels))
-    return path
+    ok = durable.atomic_write_text(
+        path, prometheus_text(snap, extra_labels),
+        site="telemetry.prom", critical=False, stream="telemetry.prom",
+        counter="telemetry/prom_write_errors")
+    return path if ok else None
 
 
 # ---------------------------------------------------------------------------

@@ -388,8 +388,10 @@ def heartbeat(iteration: int, phase: str = "train",
     a timed-out run's parent reads to say WHERE each rank was. The
     lease stamp lets any reader (`parallel.watchdog.read_cohort`)
     classify the rank alive/expired without knowing the run's config.
-    File writes are plain write+rename (no fsync: evidence, not
-    durability)."""
+    File writes go through the durable layer with fsync OFF and zero
+    retries (evidence, not durability — a heartbeat sleeping in retry
+    backoff reads as an expired lease): failures drop into the
+    `watchdog/heartbeat_write_errors` counter, never into training."""
     if _enabled:
         _registry.gauge("heartbeat/iteration",
                         {"phase": phase}).set(float(iteration))
@@ -415,10 +417,8 @@ def heartbeat(iteration: int, phase: str = "train",
                "pid": os.getpid()}
         if lease > 0:
             rec["lease_s"] = lease
-        tmp = _HEARTBEAT_FILE + ".tmp"
-        try:
-            with open(tmp, "w") as fh:
-                fh.write(json.dumps(rec) + "\n")
-            os.replace(tmp, _HEARTBEAT_FILE)
-        except OSError:
-            pass  # liveness reporting must never kill the run
+        from .. import durable
+        durable.best_effort_write_text(
+            _HEARTBEAT_FILE, json.dumps(rec) + "\n",
+            stream="watchdog.heartbeat",
+            counter="watchdog/heartbeat_write_errors")

@@ -31,7 +31,8 @@ import os
 import time
 from typing import Any, Dict, List, Optional
 
-from .. import log
+from .. import durable, log
+from ..testing import faults
 from . import metrics as metrics_mod
 from .observer import observer as _observer
 
@@ -89,27 +90,59 @@ def read_records(path: str) -> List[Dict[str, Any]]:
 
 
 class RunLog:
-    """Append-only JSONL sink for one rank."""
+    """Append-only JSONL sink for one rank.
+
+    Best-effort stream: every OS-level failure (directory cannot be
+    created, append/flush hits EIO or ENOSPC) is swallowed into the
+    `telemetry/runlog_write_errors` counter with a rate-limited warning,
+    and `write` reports it by returning False — narration must never
+    raise into the training loop it narrates. Schema violations
+    (ValueError) still raise: those are caller bugs, not disk weather.
+    A failed handle is dropped and lazily reopened on the next write, so
+    a transient full disk costs only the records written while full."""
 
     def __init__(self, directory: str, rank: int = 0):
         self.directory = directory
         self.rank = int(rank)
-        os.makedirs(directory, exist_ok=True)
         self.path = os.path.join(directory, f"runlog_r{self.rank}.jsonl")
-        self._fh = open(self.path, "a")
+        self._fh = None
+        try:
+            os.makedirs(directory, exist_ok=True)
+            self._fh = open(self.path, "a")
+        except OSError as exc:
+            durable.note_dropped("telemetry.runlog", self.path, exc,
+                                 counter="telemetry/runlog_write_errors")
 
-    def write(self, rec: Dict[str, Any]) -> None:
+    def _open(self):
+        if self._fh is None:
+            self._fh = open(self.path, "a")
+        return self._fh
+
+    def write(self, rec: Dict[str, Any]) -> bool:
+        """Append one record; returns False when the write was dropped."""
         rec.setdefault("time", time.time())
         validate_record(rec)
-        self._fh.write(json.dumps(rec, sort_keys=True,
-                                  separators=(",", ":")) + "\n")
-        self._fh.flush()
+        try:
+            faults.inject("runlog.write")
+            fh = self._open()
+            fh.write(json.dumps(rec, sort_keys=True,
+                                separators=(",", ":")) + "\n")
+            fh.flush()
+            return True
+        except OSError as exc:
+            self.close()
+            durable.note_dropped("telemetry.runlog", self.path, exc,
+                                 counter="telemetry/runlog_write_errors")
+            return False
 
     def close(self) -> None:
+        if self._fh is None:
+            return
         try:
             self._fh.close()
         except OSError:  # pragma: no cover
             pass
+        self._fh = None
 
 
 def _versions() -> Dict[str, str]:
@@ -264,9 +297,11 @@ class TrainRecorder:
         if passes:
             rec["pass"] = passes
         try:
+            # OS-level failures are absorbed inside RunLog.write (counted
+            # + rate-limited warning); only schema bugs surface here, and
+            # those disable the sink — narration must never kill training
             self.run_log.write(rec)
-        except (OSError, ValueError) as exc:
-            # narration must never kill training; drop the sink instead
+        except ValueError as exc:
             log.warning("Run log write failed (%s); disabling run log", exc)
             self.run_log = None
 
@@ -277,7 +312,7 @@ class TrainRecorder:
         rec.update({k: v for k, v in fields.items()})
         try:
             self.run_log.write(rec)
-        except (OSError, ValueError) as exc:
+        except ValueError as exc:
             log.warning("Run log write failed (%s); disabling run log", exc)
             self.run_log = None
 
@@ -332,6 +367,6 @@ class TrainRecorder:
         if self.run_log is not None:
             try:
                 self.run_log.write(summary)
-            except (OSError, ValueError):  # pragma: no cover
+            except ValueError:  # pragma: no cover
                 pass
             self.run_log.close()

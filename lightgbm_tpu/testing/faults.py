@@ -31,15 +31,27 @@ at tier-1 speed:
   circuit breaker); `compile_storm(seconds)` wedges every cold-bucket
   FIRST compile (the single-flight leader) for `seconds`, so tests can
   prove N concurrent cold requests pay exactly one compile.
+- storage fault shapes (ISSUE 18): `enospc(n)` / `eio_write(n)` make
+  the next n calls through a durable-IO site raise a REAL `OSError`
+  (`InjectedIOError`) carrying the errno, so `lightgbm_tpu/durable.py`
+  handles injected and genuine disk faults through the same
+  except-OSError path; `slow_io(site, seconds)` makes every write
+  through the site stall (NFS brown-out); `torn_write(site)` makes the
+  next publish write HALF its payload to the tmp file and die before
+  the rename — the shape atomic publication must make invisible.
+  Injection sites live inside the durable layer (`<site>.write`,
+  `<site>.rename`, plus the torn probe between body and fsync).
 - `corrupt_file` / `truncate_file` — bit-flip or cut a checkpoint on
   disk to exercise the checksum-validation / fall-back-to-previous path.
 
 Child processes arm plans through the `LGBM_TPU_FAULT_PLAN` env var — a
 JSON object with the same fields as `FaultPlan`
 (`{"kill_at_iteration": 5, "wedge": {"collective.call": 30},
-"fail": {...}, "kill_rank": [1, 5]}`) — which is how the elastic
-supervisor (`scripts/elastic_smoke.py`) injects failures into ranks it
-launches.
+"fail": {...}, "kill_rank": [1, 5],
+"io_fail": {"checkpoint.write": ["ENOSPC", 2]}, "torn": {...}}`) —
+which is how the supervisors (`scripts/elastic_smoke.py`,
+`scripts/storage_chaos_smoke.py`) inject failures into ranks they
+launch.
 
 Instrumented code calls `inject(site)` which is a no-op (one `is None`
 check) unless a plan is active, so production runs pay nothing.
@@ -47,6 +59,7 @@ check) unless a plan is active, so production runs pay nothing.
 from __future__ import annotations
 
 import contextlib
+import errno as _errno
 import json
 import os
 import time
@@ -59,6 +72,17 @@ class InjectedFault(RuntimeError):
 
     def __init__(self, site: str):
         super().__init__(f"injected fault at site '{site}'")
+        self.site = site
+
+
+class InjectedIOError(OSError):
+    """An armed storage fault: a real OSError with a real errno, so the
+    durable-IO retry loop (`lightgbm_tpu/durable.py`) cannot tell an
+    injected ENOSPC/EIO from a genuine one — by design."""
+
+    def __init__(self, site: str, errname: str):
+        code = getattr(_errno, errname)
+        super().__init__(code, f"injected {errname} at site '{site}'")
         self.site = site
 
 
@@ -78,7 +102,9 @@ class FaultPlan:
                  fail: Optional[Dict[str, int]] = None,
                  wedge: Optional[Dict[str, float]] = None,
                  kill_rank: Optional[Tuple[int, int]] = None,
-                 slow: Optional[Dict[str, float]] = None):
+                 slow: Optional[Dict[str, float]] = None,
+                 io_fail: Optional[Dict[str, Tuple[str, int]]] = None,
+                 torn: Optional[Dict[str, int]] = None):
         self.kill_at_iteration = kill_at_iteration
         self.fail = dict(fail or {})
         # site -> seconds: the next call through the site sleeps (once)
@@ -86,6 +112,13 @@ class FaultPlan:
         # site -> seconds: EVERY call through the site sleeps (sustained
         # slowness, the overload shape — wedge is for one-shot hangs)
         self.slow = {k: float(v) for k, v in (slow or {}).items()}
+        # site -> [errno-name, count]: the next `count` calls through
+        # the site raise InjectedIOError with that errno (storage shape)
+        self.io_fail = {k: [str(v[0]), int(v[1])]
+                        for k, v in (io_fail or {}).items()}
+        # site -> count: the next `count` durable publishes through the
+        # site write half their payload then die before the rename
+        self.torn = {k: int(v) for k, v in (torn or {}).items()}
         # (rank, at_iteration): preempt only that rank
         self.kill_rank = tuple(kill_rank) if kill_rank else None
         self.fired: List[str] = []   # audit log of injected faults
@@ -119,26 +152,33 @@ def _load_env_plan() -> None:
             fail=d.get("fail"),
             wedge=d.get("wedge"),
             kill_rank=d.get("kill_rank"),
-            slow=d.get("slow"))
+            slow=d.get("slow"),
+            io_fail=d.get("io_fail"),
+            torn=d.get("torn"))
     except (ValueError, TypeError) as exc:
         raise ValueError(
             f"Unparseable {FAULT_PLAN_ENV}: {spec!r} ({exc})") from exc
+
+
+def _active_plan() -> Optional[FaultPlan]:
+    """The armed plan, loading LGBM_TPU_FAULT_PLAN on first probe."""
+    if _plan is None:
+        if _env_checked:
+            return None
+        _load_env_plan()
+    return _plan
 
 
 def inject(site: str, iteration: Optional[int] = None) -> None:
     """Injection point. Called from instrumented production code; no-op
     unless a plan is active. `iteration` is only consulted by the
     `train.iteration` site (the engine loop's preemption point)."""
-    if _plan is None:
-        if _env_checked:
-            return
-        _load_env_plan()
-        if _plan is None:
-            return
     # snapshot: a serving test's main thread may reset() while a
     # batcher thread is mid-sleep inside a slow/wedge injection — the
     # rest of this call must keep operating on the plan it started with
-    plan = _plan
+    plan = _active_plan()
+    if plan is None:
+        return
     if site == "train.iteration" and iteration is not None:
         if (plan.kill_at_iteration is not None
                 and iteration >= plan.kill_at_iteration):
@@ -168,6 +208,25 @@ def inject(site: str, iteration: Optional[int] = None) -> None:
         plan.fail[site] = remaining - 1
         plan.fired.append(site)
         raise InjectedFault(site)
+    spec = plan.io_fail.get(site)
+    if spec is not None and spec[1] > 0:
+        spec[1] -= 1
+        plan.fired.append(f"{spec[0].lower()}@{site}")
+        raise InjectedIOError(site, spec[0])
+
+
+def take_torn(site: str) -> bool:
+    """Probe consumed by the durable layer between body-write and fsync:
+    True means this publish must tear (write half, die pre-rename)."""
+    plan = _active_plan()
+    if plan is None:
+        return False
+    n = plan.torn.get(site, 0)
+    if n <= 0:
+        return False
+    plan.torn[site] = n - 1
+    plan.fired.append(f"torn@{site}")
+    return True
 
 
 @contextlib.contextmanager
@@ -175,12 +234,15 @@ def active(kill_at_iteration: Optional[int] = None,
            fail: Optional[Dict[str, int]] = None,
            wedge: Optional[Dict[str, float]] = None,
            kill_rank: Optional[Tuple[int, int]] = None,
-           slow: Optional[Dict[str, float]] = None):
+           slow: Optional[Dict[str, float]] = None,
+           io_fail: Optional[Dict[str, Tuple[str, int]]] = None,
+           torn: Optional[Dict[str, int]] = None):
     """Arm a fault plan for the duration of the with-block."""
     global _plan
     prev = _plan
     _plan = FaultPlan(kill_at_iteration=kill_at_iteration, fail=fail,
-                      wedge=wedge, kill_rank=kill_rank, slow=slow)
+                      wedge=wedge, kill_rank=kill_rank, slow=slow,
+                      io_fail=io_fail, torn=torn)
     try:
         yield _plan
     finally:
@@ -247,6 +309,43 @@ def compile_storm(seconds: float = 0.25) -> FaultPlan:
     trace, while the followers wait under their deadlines or shed."""
     plan = _ensure_plan()
     plan.slow["serving.compile"] = float(seconds)
+    return plan
+
+
+# ---------------------------------------------------------------------------
+# storage fault shapes (ISSUE 18) — sites live inside lightgbm_tpu/durable.py
+# ---------------------------------------------------------------------------
+def enospc(n: int = 1, site: str = "checkpoint.write") -> FaultPlan:
+    """The next `n` writes through `site` fail with a real ENOSPC (disk
+    full) — the shape the checkpoint manager's oldest-snapshot eviction
+    escape hatch exists for."""
+    plan = _ensure_plan()
+    plan.io_fail[str(site)] = ["ENOSPC", int(n)]
+    return plan
+
+
+def eio_write(n: int = 1, site: str = "checkpoint.write") -> FaultPlan:
+    """The next `n` writes through `site` fail with a real EIO (the
+    transient-NFS-hiccup shape the retry/backoff policy absorbs)."""
+    plan = _ensure_plan()
+    plan.io_fail[str(site)] = ["EIO", int(n)]
+    return plan
+
+
+def slow_io(site: str, seconds: float) -> FaultPlan:
+    """EVERY write through `site` stalls for `seconds` (storage
+    brown-out) — the per-write deadline's reason to exist."""
+    plan = _ensure_plan()
+    plan.slow[str(site)] = float(seconds)
+    return plan
+
+
+def torn_write(site: str = "checkpoint", n: int = 1) -> FaultPlan:
+    """The next `n` durable publishes through `site` write HALF their
+    payload to the tmp file and die before the rename. The atomic
+    publish must leave no partial target visible."""
+    plan = _ensure_plan()
+    plan.torn[str(site)] = int(n)
     return plan
 
 

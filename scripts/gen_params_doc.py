@@ -84,6 +84,16 @@ DESCRIPTIONS = {
                           "changes restore exact state but f32 "
                           "summation order differs). false = refuse "
                           "world-size changes",
+    "tpu_io_retries": "retries per critical durable write (checkpoint/"
+                      "artifact/cache) on transient IO errors; "
+                      "exhaustion raises a structured DurableWriteError "
+                      "naming path, errno and attempts",
+    "tpu_io_backoff_s": "initial retry backoff for durable writes, "
+                        "doubling per attempt",
+    "tpu_io_deadline_s": "wall-clock budget for one durable write "
+                         "including retries (0 = unbounded); a slow-IO "
+                         "stall fails the write instead of wedging "
+                         "training",
     "tpu_telemetry_dir": "observability directory: a structured JSONL "
                          "run log (header + one record per iteration + "
                          "events + summary; see README Observability) "

@@ -1,0 +1,22 @@
+"""Positive fixture: the raw atomic-publish idiom re-implemented
+outside durable.py — every primitive call must be flagged."""
+import os
+import tempfile
+
+
+def save_state(path, data):
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path))  # finding
+    with os.fdopen(fd, "wb") as fh:
+        fh.write(data)
+        os.fsync(fh.fileno())  # finding
+    os.replace(tmp, path)  # finding
+
+
+def rotate_log(path):
+    os.rename(path, path + ".1")  # finding
+
+
+def spill(blob):
+    with tempfile.NamedTemporaryFile(delete=False) as fh:  # finding
+        fh.write(blob)
+    return fh.name

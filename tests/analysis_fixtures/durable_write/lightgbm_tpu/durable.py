@@ -1,0 +1,13 @@
+"""Negative fixture: durable.py itself is the one module allowed to
+hold the raw publish primitives."""
+import os
+import tempfile
+
+
+def _publish_once(path, data):
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path))
+    with os.fdopen(fd, "wb") as fh:
+        fh.write(data)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)

@@ -1,0 +1,26 @@
+"""Negative fixture: writes routed through the durable layer, plus the
+shapes the rule must NOT flag (plain open-for-write user output,
+unrelated os/tempfile attributes)."""
+import os
+import tempfile
+
+from . import durable
+
+
+def save_state(path, data):
+    durable.atomic_write_bytes(path, data, site="fixture.state")
+
+
+def narrate(path, line):
+    durable.best_effort_write_text(path, line, stream="fixture.narration")
+
+
+def user_output(path, text):
+    # plain open-for-write is not durable state (CLI model dumps etc.)
+    with open(path, "w") as fh:
+        fh.write(text)
+
+
+def unrelated():
+    os.replace_count = 1  # attribute store, not a call
+    return os.path.join(tempfile.gettempdir(), "scratch")

@@ -1,0 +1,8 @@
+"""Negative fixture: scripts/tests outside a lightgbm_tpu package
+directory own their tmp-file hygiene — out of scope."""
+import os
+
+
+def swap(a, b):
+    os.replace(a, b)
+    os.rename(b, a)

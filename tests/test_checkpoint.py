@@ -274,6 +274,40 @@ def test_manager_rotation_keeps_last_k(tmp_path):
     assert path.endswith("ckpt_00000005.r0")
 
 
+def test_kill_between_write_and_rotate_keeps_both_neighbors(tmp_path):
+    """The write-then-rotate ordering invariant (ISSUE 18): a run killed
+    after the new snapshot became durable but BEFORE rotation pruned the
+    old one must leave both on disk, resume from the newest, and let the
+    next successful save rotate normally."""
+    manager = ckpt_mod.CheckpointManager(str(tmp_path), keep_last=1)
+    manager.save({"iteration": 1}, 1)
+    with faults.active(fail={"checkpoint.rotate": 1}):
+        with pytest.raises(faults.InjectedFault):
+            manager.save({"iteration": 2}, 2)
+    # the new snapshot was already durable; the old one was never pruned
+    assert manager.available_iterations() == [1, 2]
+    payload, _ = manager.load_latest()
+    assert payload["iteration"] == 2
+    # the next save's rotation reclaims the backlog down to keep_last
+    manager.save({"iteration": 3}, 3)
+    assert manager.available_iterations() == [3]
+
+
+def test_kill_mid_write_keeps_previous_newest_loadable(tmp_path):
+    """A save that dies before its rename publishes nothing: the
+    previous newest snapshot stays the resume state and no tmp residue
+    survives (the durable layer unlinks on any failure)."""
+    manager = ckpt_mod.CheckpointManager(str(tmp_path), keep_last=2)
+    manager.save({"iteration": 1}, 1)
+    with faults.active(fail={"checkpoint.rename": 1}):
+        with pytest.raises(faults.InjectedFault):
+            manager.save({"iteration": 2}, 2)
+    assert manager.available_iterations() == [1]
+    payload, _ = manager.load_latest()
+    assert payload["iteration"] == 1
+    assert [n for n in os.listdir(tmp_path) if ".tmp." in n] == []
+
+
 def test_manager_rejects_newer_format_version(tmp_path):
     manager = ckpt_mod.CheckpointManager(str(tmp_path))
     path = manager.save({"iteration": 1}, 1)

@@ -1,0 +1,290 @@
+"""Storage-fault matrix for the durable IO layer (ISSUE 18).
+
+Every shape `testing/faults.py` can inject is driven through
+`lightgbm_tpu/durable.py` here: transient EIO absorbed by retries,
+exhaustion raising the structured `DurableWriteError`, the checkpoint
+manager's ENOSPC oldest-snapshot eviction hatch, torn writes leaving no
+partial target, best-effort streams degrading to counted drops instead
+of raising, read-side quarantine of corrupt files, and fault-plan
+arming through the LGBM_TPU_FAULT_PLAN env contract the chaos smoke's
+children use."""
+import errno
+import json
+import os
+import struct
+
+import pytest
+
+from lightgbm_tpu import durable
+from lightgbm_tpu.checkpoint import CheckpointManager
+from lightgbm_tpu.ingest.cache import MAGIC as CACHE_MAGIC, CacheCorrupt, \
+    load_cache
+from lightgbm_tpu.telemetry import metrics as metrics_mod
+from lightgbm_tpu.telemetry.runlog import RunLog
+from lightgbm_tpu.testing import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_io_state():
+    faults.reset()
+    durable.reset_for_tests()
+    yield
+    faults.reset()
+    durable.reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# retry policy
+# ---------------------------------------------------------------------------
+def test_transient_eio_absorbed_by_retries(tmp_path):
+    path = str(tmp_path / "state.bin")
+    with faults.active(io_fail={"t.write": ("EIO", 2)}) as plan:
+        ok = durable.atomic_write_bytes(path, b"payload", site="t",
+                                        retries=2, backoff_s=0.0)
+    assert ok is True
+    with open(path, "rb") as fh:
+        assert fh.read() == b"payload"
+    assert plan.fired == ["eio@t.write", "eio@t.write"]
+
+
+def test_retry_exhaustion_raises_structured_error(tmp_path):
+    path = str(tmp_path / "state.bin")
+    with faults.active(io_fail={"t.write": ("EIO", 9)}):
+        with pytest.raises(durable.DurableWriteError) as ei:
+            durable.atomic_write_bytes(path, b"x", site="t",
+                                       retries=1, backoff_s=0.0)
+    err = ei.value
+    assert err.path == path
+    assert err.site == "t"
+    assert err.attempts == 2          # 1 try + 1 retry
+    assert err.errno == errno.EIO
+    msg = str(err)
+    assert path in msg and "EIO" in msg and "2 attempt" in msg
+    assert not os.path.exists(path)   # nothing partial published
+
+
+def test_deadline_bounds_slow_io_retries(tmp_path):
+    """A storage brown-out (every attempt stalls) must fail within the
+    per-write deadline instead of grinding through the whole retry
+    budget."""
+    path = str(tmp_path / "state.bin")
+    with faults.active(io_fail={"t.write": ("EIO", 99)},
+                       slow={"t.write": 0.15}):
+        with pytest.raises(durable.DurableWriteError) as ei:
+            durable.atomic_write_bytes(path, b"x", site="t", retries=50,
+                                       backoff_s=0.0, deadline_s=0.25)
+    assert ei.value.attempts < 51     # the deadline cut the budget short
+
+
+def test_configure_and_policy_roundtrip():
+    durable.configure(retries=7, backoff_s=0.5, deadline_s=9.0)
+    assert durable.policy() == {"retries": 7, "backoff_s": 0.5,
+                                "deadline_s": 9.0}
+    durable.reset_for_tests()
+    assert durable.policy()["retries"] == durable.DEFAULT_RETRIES
+
+
+# ---------------------------------------------------------------------------
+# ENOSPC escape hatch (checkpoint manager)
+# ---------------------------------------------------------------------------
+def _save(mgr, iteration):
+    return mgr.save({"iteration": iteration}, iteration)
+
+
+def test_enospc_evicts_oldest_snapshot_and_retries(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), keep_last=3, rank=0)
+    _save(mgr, 1)
+    _save(mgr, 2)
+    durable.configure(retries=0, backoff_s=0.0)
+    with faults.active(io_fail={"checkpoint.write": ("ENOSPC", 1)}):
+        _save(mgr, 3)                 # hatch frees iter 1, retry lands
+    assert mgr.available_iterations() == [2, 3]
+    payload, path = mgr.load_latest()
+    assert payload["iteration"] == 3 and path.endswith("00000003.r0")
+
+
+def test_enospc_never_evicts_newest_snapshot(tmp_path):
+    """With only one snapshot on disk the hatch must refuse (the newest
+    durable snapshot is the resume state) and the save fails — leaving
+    that snapshot loadable."""
+    mgr = CheckpointManager(str(tmp_path), keep_last=3, rank=0)
+    _save(mgr, 1)
+    durable.configure(retries=0, backoff_s=0.0)
+    with faults.active(io_fail={"checkpoint.write": ("ENOSPC", 9)}):
+        with pytest.raises(durable.DurableWriteError) as ei:
+            _save(mgr, 2)
+    assert ei.value.errno == errno.ENOSPC
+    assert mgr.available_iterations() == [1]
+    payload, _ = mgr.load_latest()
+    assert payload["iteration"] == 1
+
+
+# ---------------------------------------------------------------------------
+# torn writes
+# ---------------------------------------------------------------------------
+def test_torn_write_leaves_no_partial_target(tmp_path):
+    path = str(tmp_path / "state.bin")
+    durable.atomic_write_bytes(path, b"old-consistent", site="t")
+    with faults.active(torn={"t": 1}):
+        with pytest.raises(durable.DurableWriteError):
+            durable.atomic_write_bytes(path, b"new-payload!", site="t",
+                                       retries=0, backoff_s=0.0)
+    with open(path, "rb") as fh:
+        assert fh.read() == b"old-consistent"   # old-or-new, never hybrid
+    assert [n for n in os.listdir(tmp_path) if ".tmp." in n] == []
+
+
+def test_torn_write_then_retry_succeeds(tmp_path):
+    path = str(tmp_path / "state.bin")
+    with faults.active(torn={"t": 1}) as plan:
+        ok = durable.atomic_write_bytes(path, b"payload", site="t",
+                                        retries=1, backoff_s=0.0)
+    assert ok and plan.fired == ["torn@t"]
+    with open(path, "rb") as fh:
+        assert fh.read() == b"payload"
+
+
+# ---------------------------------------------------------------------------
+# best-effort degradation
+# ---------------------------------------------------------------------------
+def test_best_effort_drops_count_instead_of_raising(tmp_path):
+    path = str(tmp_path / "narration.txt")
+    metrics_mod.enable(True)
+    try:
+        with faults.active(io_fail={"s.write": ("EIO", 9)}):
+            ok = durable.atomic_write_text(path, "x", site="s",
+                                           critical=False, stream="s",
+                                           retries=1, backoff_s=0.0)
+        assert ok is False
+        assert durable.dropped("s") == 1
+        assert durable.dropped() == {"s": 1}
+        reg = metrics_mod.registry()
+        tallies = {c.name: c.value for c in reg.counters.values()}
+        assert tallies.get("io/dropped_writes") == 1.0
+        assert tallies.get("io/write_retries") == 1.0
+    finally:
+        metrics_mod.enable(False)
+
+
+def test_best_effort_warning_is_rate_limited(tmp_path):
+    from lightgbm_tpu import log
+    path = str(tmp_path / "narration.txt")
+    lines = []
+    log.register_callback(lines.append)
+    try:
+        with faults.active(io_fail={"s.write": ("EIO", 99)}):
+            for _ in range(5):
+                durable.atomic_write_text(path, "x", site="s",
+                                          critical=False, stream="s",
+                                          retries=0, backoff_s=0.0)
+    finally:
+        log.register_callback(None)
+    assert durable.dropped("s") == 5
+    warned = [l for l in lines if "Best-effort write" in l]
+    assert len(warned) == 1           # first drop warns, repeats silent
+
+
+def test_runlog_write_failure_never_raises(tmp_path):
+    rl = RunLog(str(tmp_path), rank=0)
+    with faults.active(io_fail={"runlog.write": ("EIO", 1)}):
+        assert rl.write({"type": "event", "kind": "probe"}) is False
+    assert durable.dropped("telemetry.runlog") == 1
+    # the sink reopens lazily and keeps narrating after the fault clears
+    assert rl.write({"type": "event", "kind": "probe2"}) is True
+    rl.close()
+    with open(rl.path) as fh:
+        kinds = [json.loads(l)["kind"] for l in fh if l.strip()]
+    assert kinds == ["probe2"]
+    # schema violations are caller bugs and still raise
+    with pytest.raises(ValueError):
+        RunLog(str(tmp_path), rank=1).write({"type": "event"})
+
+
+def test_heartbeat_write_failure_never_raises(tmp_path):
+    hb = str(tmp_path / "hb.json")
+    metrics_mod.set_heartbeat_file(hb)
+    try:
+        with faults.active(
+                io_fail={"watchdog.heartbeat.write": ("EIO", 1)}):
+            metrics_mod.heartbeat(7, rank=0)   # dropped, not raised
+        assert durable.dropped("watchdog.heartbeat") == 1
+        assert not os.path.exists(hb)
+        metrics_mod.heartbeat(8, rank=0)
+        with open(hb) as fh:
+            assert json.loads(fh.read())["iteration"] == 8
+    finally:
+        metrics_mod.set_heartbeat_file("")
+
+
+def test_prometheus_dump_failure_returns_none(tmp_path):
+    from lightgbm_tpu.telemetry import export as tele_export
+    durable.configure(retries=0, backoff_s=0.0)
+    missing_dir = str(tmp_path / "no_such_dir" / "m.prom")
+    assert tele_export.write_prometheus(missing_dir) is None
+    assert durable.dropped("telemetry.prom") == 1
+    ok_path = str(tmp_path / "m.prom")
+    assert tele_export.write_prometheus(ok_path) == ok_path
+    assert os.path.exists(ok_path)
+
+
+# ---------------------------------------------------------------------------
+# read-side quarantine
+# ---------------------------------------------------------------------------
+def test_quarantine_renames_and_prunes_keep_last_one(tmp_path):
+    for i, name in enumerate(["a.bin", "b.bin", "c.bin"]):
+        p = tmp_path / name
+        p.write_bytes(b"junk")
+        q = durable.quarantine(str(p))
+        assert q == str(p) + ".corrupt"
+        assert not p.exists() and os.path.exists(q)
+        os.utime(q, (i, i))           # deterministic mtime ordering
+        durable.prune_quarantined(str(tmp_path), keep_last=1)
+    left = sorted(n for n in os.listdir(tmp_path) if n.endswith(".corrupt"))
+    assert left == ["c.bin.corrupt"]
+
+
+def test_cache_corruption_quarantines_and_raises(tmp_path):
+    path = str(tmp_path / "data.bin")
+    with open(path, "wb") as fh:      # right magic, garbled header
+        fh.write(CACHE_MAGIC)
+        fh.write(struct.pack("<q", 1 << 40))
+    with pytest.raises(CacheCorrupt) as ei:
+        load_cache(path)
+    assert "quarantined" in str(ei.value)
+    assert not os.path.exists(path)
+    assert os.path.exists(path + ".corrupt")
+
+
+def test_checkpoint_load_latest_quarantines_corrupt_snapshot(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), keep_last=3, rank=0)
+    _save(mgr, 1)
+    newest = _save(mgr, 2)
+    faults.corrupt_file(newest)
+    payload, path = mgr.load_latest()
+    assert payload["iteration"] == 1  # fell back to the previous one
+    assert not os.path.exists(newest)
+    assert os.path.exists(newest + ".corrupt")
+
+
+# ---------------------------------------------------------------------------
+# env-plan arming (the chaos smoke's child contract)
+# ---------------------------------------------------------------------------
+def test_fault_plan_env_arms_storage_shapes(tmp_path, monkeypatch):
+    plan = {"io_fail": {"t.write": ["EIO", 1]}, "torn": {"t": 1}}
+    monkeypatch.setenv(faults.FAULT_PLAN_ENV, json.dumps(plan))
+    faults._plan = None
+    faults._env_checked = False
+    path = str(tmp_path / "state.bin")
+    try:
+        ok = durable.atomic_write_bytes(path, b"x", site="t",
+                                        critical=False, stream="t",
+                                        retries=0, backoff_s=0.0)
+        assert ok is False            # env-armed EIO fired
+        ok = durable.atomic_write_bytes(path, b"x", site="t",
+                                        critical=False, stream="t",
+                                        retries=0, backoff_s=0.0)
+        assert ok is False            # env-armed torn write fired
+        assert faults._plan.fired == ["eio@t.write", "torn@t"]
+        assert durable.dropped("t") == 2
+    finally:
+        faults.reset()

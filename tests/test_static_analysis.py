@@ -77,6 +77,7 @@ FLAT_RULES = {
     "future-guard": "future_guard",
     "stdout-print": "stdout_print",
     "export-import-hygiene": "export_import_hygiene",
+    "durable-write": "durable_write",
 }
 
 
