@@ -304,13 +304,19 @@ class Dataset:
             # (reference datasets) are consumed host-side by add_valid
             landing_factory=(_device_landing_factory(params)
                              if ref_inner is None else None))
+        # linear_tree fits per-leaf regressions on RAW feature values:
+        # arm keep_raw automatically so params-routed training (engine,
+        # sklearn, CLI) never trips the booster's keep_raw refusal
+        linear_tree = _parse_value(
+            params.get("linear_tree", params.get("linear_trees", False)),
+            bool)
         if streamed_source is not None:
             from .ingest import build_inner
             self._inner = build_inner(streamed_source,
-                                      keep_raw=False, **build_kwargs)
+                                      keep_raw=linear_tree, **build_kwargs)
         else:
             self._inner = _InnerDataset.from_numpy(
-                data, keep_raw=not self.free_raw_data,
+                data, keep_raw=(not self.free_raw_data) or linear_tree,
                 chunk_rows=int(params.get("tpu_ingest_chunk_rows", 65536)),
                 **build_kwargs)
         self._constructed_max_bin = max_bin
@@ -497,8 +503,6 @@ class Booster:
         rebuilt by replaying the existing trees."""
         import jax.numpy as jnp
 
-        from .ops.predict import predict_value_binned
-
         old = self._inner
         models = old.models
         it = old.iter_
@@ -539,8 +543,12 @@ class Booster:
         acc = jnp.zeros_like(fresh._score)
         for i, tree in enumerate(models):
             if tree.num_leaves > 1:
-                acc = acc.at[i % k].add(
-                    predict_value_binned(tree.to_device(), fresh._binned))
+                # linear trees replay via leaf ids + raw values (the
+                # binned-only path refuses them); fresh.init landed _raw
+                # when the config has linear_tree=true
+                acc = acc.at[i % k].add(fresh._tree_values_device(
+                    tree.to_device(), fresh._binned,
+                    getattr(fresh, "_raw", None)))
         if fresh.average_output and it > 0:
             acc = acc / float(it)
         fresh._score = fresh._score + acc

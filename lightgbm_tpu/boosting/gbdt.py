@@ -208,6 +208,50 @@ def _grow_and_update(score, binned, grad, hess, row_weight, fmask,
 _grow_and_update_jit = None
 
 
+def _fit_linear_post(raw, grad, hess, row_weight, state, linear_lambda,
+                     cfg, k_feats):
+    """Post-growth piecewise-linear leaf fit + train-score values, ONE
+    device program shared by the serial and distributed paths.
+
+    The fit is deliberately OUTSIDE the grower: it consumes only
+    schedule-invariant inputs (final leaf assignment, the leaf->root
+    split-feature paths, raw feature values, grad/hess, row weights), so
+    a serial grow and a scatter-reduce data-parallel grow that assign
+    rows to the same leaves produce BIT-IDENTICAL coefficients — it is
+    literally the same compiled program on identical operands (the
+    serial-vs-scatter identity test pins this)."""
+    import jax
+    import jax.numpy as jnp
+    global _fit_linear_jit
+    if _fit_linear_jit is None:
+        def impl(raw, grad, hess, row_weight, leaf_id, leaf_parent,
+                 node_feature, node_left, node_right, num_leaves_used,
+                 leaf_const, lam, cfg, k_feats):
+            from ..learner.grow import leaf_path_features
+            from ..linear.solver import fit_leaves, linear_row_values
+            lid = jnp.clip(leaf_id, 0, cfg.num_leaves - 1)
+            feats = leaf_path_features(leaf_parent, node_feature,
+                                       node_left, node_right,
+                                       num_leaves_used, k_feats)
+            leaf_value, leaf_coeff, _ = fit_leaves(
+                raw, grad, hess, row_weight, lid, feats, leaf_const,
+                lam, cfg.num_leaves)
+            vals = linear_row_values(raw, lid, leaf_value, leaf_coeff,
+                                     feats)
+            return leaf_value, leaf_coeff, feats, vals
+
+        _fit_linear_jit = jax.jit(impl, static_argnames=("cfg", "k_feats"))
+    return _fit_linear_jit(raw, grad, hess, row_weight, state.leaf_id,
+                           state.leaf_parent, state.node_feature,
+                           state.node_left, state.node_right,
+                           state.num_leaves_used, state.leaf_value,
+                           jnp.float32(linear_lambda), cfg=cfg,
+                           k_feats=k_feats)
+
+
+_fit_linear_jit = None
+
+
 def _grow_and_update_multi_impl(score, binned, grads, hesses, row_weight,
                                 fmasks, shrinkage, n_valid, fmeta_args, cfg):
     """Grow ALL num_class trees of one boosting iteration in ONE device
@@ -521,6 +565,47 @@ class GBDT:
         self._base_weight = jnp.asarray(
             _pad_to(np.ones(n, np.float32), n_pad))
 
+        # piecewise-linear leaves (linear_tree): the post-growth leaf
+        # regression needs RAW feature values on device. Landed in the
+        # USED-feature (inner) space so leaf_path_features' inner-space
+        # indices address it directly; padding rows are ZEROS so the
+        # padded score tail stays finite (the non-finite gradient probe
+        # reduces over the whole padded array).
+        self._linear = bool(self.config.tree.linear_tree)
+        self._linear_k = int(self.config.tree.tpu_linear_max_features)
+        self._raw = None
+        if self._linear:
+            if self.config.boosting_type not in ("gbdt", "goss"):
+                raise log.LightGBMError(
+                    "linear_tree supports boosting=gbdt/goss only (got "
+                    "%s): dart re-normalization and RF averaging replay "
+                    "trees through the binned-only path"
+                    % self.config.boosting_type)
+            if self.num_tree_per_iteration > 1:
+                raise log.LightGBMError(
+                    "linear_tree does not support multiclass training "
+                    "(num_tree_per_iteration=%d); train one-vs-all "
+                    "boosters or set linear_tree=false"
+                    % self.num_tree_per_iteration)
+            if nproc > 1:
+                raise log.LightGBMError(
+                    "linear_tree does not support multi-host training "
+                    "(the leaf regression needs the global raw matrix "
+                    "resident on every process); set linear_tree=false")
+            if train_data.raw is None:
+                raise log.LightGBMError(
+                    "linear_tree requires raw feature values: construct "
+                    "the training Dataset with keep_raw=true (params "
+                    "routed through engine.train/sklearn arm this "
+                    "automatically)")
+            raw_inner = np.asarray(train_data.raw, np.float32)[
+                :, train_data.used_features]
+            self._raw = jnp.asarray(_pad_to(raw_inner, n_pad))
+            # the async tree pipeline fuses grow+update into one program
+            # keyed on constant leaf outputs; the linear fit is a second
+            # program with its own score update, so run synchronous
+            self._supports_pipeline = False
+
         # scores: [num_tree_per_iteration, n_pad]
         k = self.num_tree_per_iteration
         self._score = jnp.zeros((k, n_pad), jnp.float32)
@@ -775,8 +860,22 @@ class GBDT:
         if not hasattr(self, "_valid_binned"):
             self._valid_binned = []
             self._valid_score = []
+        if not hasattr(self, "_valid_raw"):
+            self._valid_raw = []
         vb = jnp.asarray(valid_data.binned)
         self._valid_binned.append(vb)
+        # linear trees evaluate coeff . x on raw values: land the valid
+        # set's raw matrix (inner space, unpadded like vb) alongside
+        vraw = None
+        if getattr(self, "_linear", False) \
+                or any(getattr(t, "is_linear", False) for t in self.models):
+            if valid_data.raw is None:
+                raise log.LightGBMError(
+                    "linear_tree validation needs raw feature values: "
+                    "construct the valid Dataset with keep_raw=true")
+            vraw = jnp.asarray(np.asarray(valid_data.raw, np.float32)[
+                :, self.train_data.used_features])
+        self._valid_raw.append(vraw)
         k = self.num_tree_per_iteration
         vs = jnp.zeros((k, valid_data.num_data), jnp.float32)
         init_score = valid_data.metadata.init_score
@@ -795,7 +894,8 @@ class GBDT:
         for it in range(self.iter_):
             for cls in range(k):
                 tree = self.models[it * k + cls]
-                acc = acc.at[cls].add(predict_value_binned(tree.to_device(), vb))
+                acc = acc.at[cls].add(self._tree_values_device(
+                    tree.to_device(), vb, vraw))
         if self.average_output and self.iter_ > 0:
             acc = acc / float(self.iter_)
         self._valid_score.append(vs + acc)
@@ -943,7 +1043,36 @@ class GBDT:
         could_split_any = False
         for cls in range(k):
             mask = self._feature_mask()
-            if self._dist_grower is None:
+            if getattr(self, "_linear", False):
+                # piecewise-linear leaves: plain grow (serial OR
+                # distributed), then the shared post-growth fit program
+                # replaces the constant leaf outputs with fitted
+                # intercept+slopes and returns the per-row training
+                # values (pre-shrinkage) for the score update
+                with tracing.phase("tree/grow"):
+                    state = self._grow(grad[cls], hess[cls], row_weight,
+                                       mask)
+                with tracing.phase("tree/linear_fit"):
+                    leaf_value, leaf_coeff, feats, vals = _fit_linear_post(
+                        self._raw, grad[cls], hess[cls], row_weight,
+                        state, self.config.tree.linear_lambda,
+                        self._grower_cfg, self._linear_k)
+                with tracing.phase("tree/extract"):
+                    small = {key: getattr(state, key)
+                             for key in _SMALL_STATE_KEYS}
+                    small["leaf_value"] = leaf_value
+                    small["leaf_coeff"] = leaf_coeff
+                    small["leaf_features_inner"] = feats
+                    host_state = _HostState(jax.device_get(small))
+                    tree = Tree.from_grower_state(host_state,
+                                                  self.train_data)
+                self._log_pass_economics(host_state)
+                if tree.num_leaves > 1:
+                    tree.apply_shrinkage(self.shrinkage_rate)
+                    with tracing.phase("boosting/update_score"):
+                        self._score = self._score.at[cls].add(
+                            jnp.float32(self.shrinkage_rate) * vals)
+            elif self._dist_grower is None:
                 # serial learner: grow + score update as ONE device
                 # program, then ONE host fetch of the small tree arrays
                 with tracing.phase("tree/grow"):
@@ -1197,15 +1326,37 @@ class GBDT:
             "a custom objective overflowed; set tpu_guard_nonfinite="
             "false to disable this check." % (name, iteration))
 
+    def _tree_values_device(self, dtree, binned, raw):
+        """Per-row values of one device tree over a binned matrix.
+        Constant-leaf trees gather leaf_value from the binned traversal;
+        linear trees additionally need the RAW (inner-space) matrix for
+        the leaf-gathered coeff . x term — predict_value_binned refuses
+        them by design (ops/predict.py)."""
+        import jax.numpy as jnp
+
+        from ..ops.predict import linear_leaf_addend
+        if dtree.leaf_coeff is None or dtree.leaf_coeff.shape[-1] == 0:
+            return predict_value_binned(dtree, binned)
+        if raw is None:
+            raise log.LightGBMError(
+                "linear_tree score replay needs raw feature values for "
+                "this dataset: construct it with keep_raw=true")
+        lid = predict_leaf_binned(dtree, binned)
+        return dtree.leaf_value[lid].astype(jnp.float32) \
+            + linear_leaf_addend(dtree.leaf_coeff, dtree.leaf_feat, lid,
+                                 raw)
+
     def _update_valid_scores(self, cls: int, tree) -> None:
         from .. import tracing
         with tracing.phase("boosting/update_valid_score"):
             dtree = tree.to_device() if self.valid_sets else None
+            vraws = getattr(self, "_valid_raw", None)
             for vi in range(len(self.valid_sets)):
                 self._valid_score[vi] = \
                     self._valid_score[vi].at[cls].add(
-                        predict_value_binned(
-                            dtree, self._valid_binned[vi]))
+                        self._tree_values_device(
+                            dtree, self._valid_binned[vi],
+                            vraws[vi] if vraws else None))
 
     def _finish_iter(self, could_split_any: bool) -> bool:
         """Advance the iteration counter, rolling the whole iteration
@@ -1269,12 +1420,17 @@ class GBDT:
             if tree.num_leaves > 1:
                 neg = copy.deepcopy(tree)
                 neg.leaf_value = -neg.leaf_value
+                neg.leaf_coeff = -neg.leaf_coeff
                 dtree = neg.to_device()
+                vraws = getattr(self, "_valid_raw", None)
                 self._score = self._score.at[cls].add(
-                    predict_value_binned(dtree, self._binned))
+                    self._tree_values_device(dtree, self._binned,
+                                             getattr(self, "_raw", None)))
                 for vi in range(len(self.valid_sets)):
                     self._valid_score[vi] = self._valid_score[vi].at[cls].add(
-                        predict_value_binned(dtree, self._valid_binned[vi]))
+                        self._tree_values_device(
+                            dtree, self._valid_binned[vi],
+                            vraws[vi] if vraws else None))
         self.iter_ -= 1
         self._bump_model_version()
 

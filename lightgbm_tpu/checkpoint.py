@@ -129,6 +129,10 @@ _FINGERPRINT_INCLUDED = {
     "tpu_compact_threshold", "tpu_hist_pallas",
     # nonfinite guard aborts the trajectory instead of continuing it
     "tpu_guard_nonfinite",
+    # piecewise-linear leaves: the per-leaf design width changes every
+    # fitted coefficient table (linear_tree/linear_lambda are non-tpu
+    # params and hash automatically)
+    "tpu_linear_max_features",
 }
 
 assert not (_FINGERPRINT_INCLUDED & _FINGERPRINT_EXCLUDE), \

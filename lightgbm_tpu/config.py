@@ -54,6 +54,7 @@ ALIAS_TABLE: Dict[str, str] = {
     "min_hessian": "min_sum_hessian_in_leaf",
     "min_child_weight": "min_sum_hessian_in_leaf",
     "num_leaf": "num_leaves",
+    "linear_trees": "linear_tree",
     "sub_feature": "feature_fraction",
     "colsample_bytree": "feature_fraction",
     "num_iteration": "num_iterations",
@@ -311,6 +312,18 @@ class TreeConfig:
     top_k: int = 20
     max_cat_threshold: int = 256
     histogram_pool_size: float = -1.0
+    # piecewise-linear leaves (reference: linear_tree, config.h +
+    # linear_tree_learner.cpp): fit a ridge regression per leaf over the
+    # features split on along the leaf's root path, replacing the
+    # constant output with intercept + coeff . x (lightgbm_tpu/linear/)
+    linear_tree: bool = False
+    # L2 on the fitted SLOPES only (the intercept is never penalized);
+    # the reference's linear_lambda
+    linear_lambda: float = 0.0
+    # per-leaf design width cap: the first tpu_linear_max_features
+    # DISTINCT root-path split features, nearest the leaf first — the
+    # static [L, k] shape every linear kernel is compiled against
+    tpu_linear_max_features: int = 5
     # TPU-specific knobs (no reference analogue; gpu_* kept for API compat)
     gpu_platform_id: int = -1
     gpu_device_id: int = -1
@@ -512,6 +525,8 @@ TPU_PARAM_SPEC = {
     "tpu_compact_threshold": ("float", None, None),  # <= 0 disables
     "tpu_hist_reduce": ("choice", "scatter", "allreduce"),
     "tpu_hist_pallas": "bool",                       # retired, warns
+    # piecewise-linear leaves
+    "tpu_linear_max_features": ("int", 1, None),
     # boosting
     "tpu_guard_nonfinite": "bool",
     # network / watchdog

@@ -533,7 +533,22 @@ def _continue_from(booster: Booster, init_booster: Booster, train_set: Dataset):
     for cls in range(k):
         class_trees = [t for i, t in enumerate(inner.models) if i % k == cls
                        and t.num_leaves > 1]
-        if class_trees:
+        if not class_trees:
+            continue
+        if any(t.is_linear for t in class_trees):
+            # linear trees cannot replay through the stacked binned-only
+            # path (coeff . x needs raw values); replay per tree via the
+            # leaf + raw route, which needs the booster's raw landing
+            if getattr(inner, "_raw", None) is None:
+                raise LightGBMError(
+                    "Continued training from a linear_tree init_model "
+                    "requires linear_tree=true in the continuing params "
+                    "(the score replay needs the raw feature matrix)")
+            for t in class_trees:
+                inner._score = inner._score.at[cls].add(
+                    inner._tree_values_device(t.to_device(),
+                                              inner._binned, inner._raw))
+        else:
             inner._score = inner._score.at[cls].add(
                 _jit_forest_binned(stack_trees(class_trees), inner._binned))
 

@@ -29,6 +29,13 @@ from .. import log
 
 MAGIC = b"lightgbm_tpu.forest_artifact.v1\n"
 FORMAT_VERSION = 1
+#: format written for piecewise-linear forests (linear_tree): their
+#: stacked-leaf sections carry per-leaf coefficient tables a format-1
+#: reader would silently drop, so the writer bumps the manifest format
+#: ONLY for them — constant-leaf artifacts stay format 1 and remain
+#: loadable by older readers, while older readers refuse linear
+#: artifacts by name (manifest section 'format')
+FORMAT_VERSION_LINEAR = 2
 #: default artifact filename inside `tpu_export_dir`
 DEFAULT_NAME = "forest.artifact"
 

@@ -27,7 +27,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from . import MAGIC, FORMAT_VERSION, ArtifactError
+from . import MAGIC, FORMAT_VERSION_LINEAR, ArtifactError
 from .. import log, telemetry
 from ..serving.forest import (CompiledForest, QUANTIZE_MODES, bucket_rows,
                               pad_rows)
@@ -76,12 +76,12 @@ def _read_header(path: str) -> Tuple[Dict[str, Any], Dict[str, Any]]:
             "Forest artifact %s has a corrupt manifest (%s); the file "
             "cannot be trusted — re-export it" % (path, exc)) from exc
     fmt = int(manifest.get("format", 0))
-    if fmt > FORMAT_VERSION:
+    if fmt > FORMAT_VERSION_LINEAR:
         raise ArtifactError(
             "Forest artifact %s has format version %d; this build "
             "supports <= %d (manifest section 'format'). Upgrade "
             "lightgbm_tpu or re-export with the older writer."
-            % (path, fmt, FORMAT_VERSION))
+            % (path, fmt, FORMAT_VERSION_LINEAR))
     return manifest, sections
 
 

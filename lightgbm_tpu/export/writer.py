@@ -27,7 +27,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from . import MAGIC, FORMAT_VERSION, ArtifactError
+from . import MAGIC, FORMAT_VERSION, FORMAT_VERSION_LINEAR, ArtifactError
 from .. import durable, log, telemetry
 from ..serving.forest import bucket_ladder, bucket_rows, pad_rows
 
@@ -300,8 +300,14 @@ def write_artifact(booster, path: str, num_iteration: int = -1,
         io_params["tpu_predict_warmup_rows"] = int(ladder[-1])
         io_params["tpu_predict_bucket_min"] = int(bucket_min)
 
+        # linear forests carry coefficient tables a format-1 reader
+        # would drop silently — bump the format ONLY for them so
+        # constant-leaf artifacts stay loadable by older readers
+        has_linear = any(getattr(t, "is_linear", False)
+                         for t in gbdt.models[:total])
         manifest = {
-            "format": FORMAT_VERSION,
+            "format": FORMAT_VERSION_LINEAR if has_linear
+            else FORMAT_VERSION,
             "jax_version": jax.__version__,
             "calling_convention_version": ccv,
             "platforms": list(platforms) if platforms else [],
@@ -320,6 +326,7 @@ def write_artifact(booster, path: str, num_iteration: int = -1,
                 "objective_name": obj.name if obj is not None else "",
                 "transform": _transform_spec(obj),
                 "has_conv": has_conv,
+                "linear_tree": has_linear,
                 "feature_names": list(gbdt.feature_names),
             },
             "layouts": layout_meta,

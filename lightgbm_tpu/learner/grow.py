@@ -1295,6 +1295,49 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     )
 
 
+def leaf_path_features(leaf_parent, node_feature, node_left, node_right,
+                       num_leaves_used, k: int):
+    """Per-leaf candidate features for linear leaves: the first `k`
+    DISTINCT split features on the leaf's root path, nearest-the-leaf
+    first ("top-k by path proximity" — the splits closest to the leaf
+    are the ones that shaped its region most recently).
+
+    Inputs are TreeGrowerState arrays: `leaf_parent[l]` is the internal
+    node whose split created leaf slot l (-1 for unused slots and the
+    single-leaf tree), node_left/node_right encode leaves as `~slot`.
+    Features are in used-feature (inner) space, like node_feature.
+    Returns [L, k] i32, -1-padded. Traceable; `k` static.
+    """
+    m = node_left.shape[0]                           # L - 1 node slots
+    nodes = jnp.arange(m, dtype=jnp.int32)
+    # parent of each internal node, scattered from the child links;
+    # only committed nodes may write (stale slots hold zeros, which
+    # would otherwise claim node 0 as their child)
+    valid = nodes < num_leaves_used - 1
+    idx_l = jnp.where(valid & (node_left >= 0), node_left, m)
+    idx_r = jnp.where(valid & (node_right >= 0), node_right, m)
+    node_parent = jnp.full(m, -1, jnp.int32)
+    node_parent = node_parent.at[idx_l].set(nodes, mode="drop")
+    node_parent = node_parent.at[idx_r].set(nodes, mode="drop")
+
+    def one_leaf(start):
+        def body(_, carry):
+            feats, cnt, node = carry
+            live = node >= 0
+            f = node_feature[jnp.maximum(node, 0)]
+            take = live & ~jnp.any(feats == f) & (cnt < k)
+            feats = feats.at[jnp.where(take, cnt, k)].set(f, mode="drop")
+            cnt = cnt + take.astype(jnp.int32)
+            node = jnp.where(live, node_parent[jnp.maximum(node, 0)], -1)
+            return feats, cnt, node
+        feats0 = jnp.full((k,), -1, jnp.int32)
+        feats, _, _ = jax.lax.fori_loop(
+            0, m, body, (feats0, jnp.int32(0), start))
+        return feats
+
+    return jax.vmap(one_leaf)(leaf_parent.astype(jnp.int32))
+
+
 def shard_group_widths(group_widths, num_shards: int):
     """Per-position max of the per-shard feature-block widths: the one
     static block plan that is correct for every feature shard (see the

@@ -1,0 +1,161 @@
+"""Batched on-device per-leaf ridge solver.
+
+One grow step fits EVERY leaf's linear model at once: for leaf l with
+path features f_1..f_k (top-k by path proximity, learner/grow.py:
+leaf_path_features) and z = [x_{f_1}, .., x_{f_k}, 1], the Newton step
+that minimizes sum_r g_r * s(x_r) + 0.5 * h_r * s(x_r)^2 over
+s(x) = beta . z is the small ridge system
+
+    (sum_r w h z z^T + linear_lambda * diag(1..1, 0)) beta = -sum_r w g z
+
+(`linear_lambda` regularizes the feature slopes only, never the
+intercept). The per-leaf sums are built as one-hot MXU contractions —
+`onehot[n, l] * channel[n]` against the row-outer-products — chunked
+over rows exactly like the histogram kernels, then ALL leaves solve as
+one batched `jnp.linalg.solve` ([L, k+1, k+1] is tiny).
+
+Fallback semantics (the reference linear_tree's, tree.cpp): a leaf
+falls back to its grower constant (coefficients zero, value = the
+constant-leaf Newton value) when its fitted row count is under
+2 * (k+1) or the solve produces non-finite coefficients (singular
+system, e.g. a feature constant within the leaf at linear_lambda=0).
+Rows with a non-finite value in any of the leaf's used features are
+excluded from the fit entirely; at prediction such rows get the
+intercept-only value (ops/predict.py gates the linear term on row
+finiteness the same way, so train and serve agree).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _gather_z(x, leaf_id, leaf_feats):
+    """Per-row design vector z = [x at the row's leaf's features, 1].
+
+    Padded feature slots (-1) contribute a structural zero; a row with
+    a non-finite value in any LIVE slot is flagged not-ok (excluded
+    from its leaf's fit). Returns (z [N, k+1], row_ok [N])."""
+    n, num_f = x.shape
+    feats = leaf_feats[leaf_id]                       # [N, k]
+    pad = feats < 0
+    xv = jnp.take_along_axis(x, jnp.clip(feats, 0, num_f - 1), axis=1)
+    finite = jnp.isfinite(xv) | pad
+    row_ok = jnp.all(finite, axis=1)
+    xv = jnp.where(pad | ~finite, 0.0, xv)
+    z = jnp.concatenate(
+        [xv, jnp.ones((n, 1), xv.dtype)], axis=1)     # [N, k+1]
+    return z.astype(jnp.float32), row_ok
+
+
+@functools.partial(jax.jit, static_argnames=("num_leaves", "chunk"))
+def fit_leaves(x, grad, hess, row_weight, leaf_id, leaf_feats,
+               leaf_const, linear_lambda, num_leaves: int,
+               chunk: int = 65536):
+    """Fit every leaf's linear model in one batched pass.
+
+    Args:
+      x:           [N, F] raw feature values (inner-feature space,
+                   padded rows arbitrary — their weight is zero).
+      grad, hess:  [N] objective gradients/hessians.
+      row_weight:  [N] bagging/GOSS weight; 0 marks out-of-bag AND
+                   padding rows, so both drop out of every sum.
+      leaf_id:     [N] leaf slot per row (the grower's final labels).
+      leaf_feats:  [L, k] i32 per-leaf feature columns into `x`,
+                   -1-padded (learner/grow.py: leaf_path_features).
+      leaf_const:  [L] the grower's constant leaf values — kept for
+                   fallback leaves, replaced by the fitted intercept
+                   otherwise.
+      linear_lambda: ridge strength on the feature slopes.
+      num_leaves:  static L.
+
+    Returns (leaf_value [L], leaf_coeff [L, k], fitted [L] bool).
+    """
+    n = x.shape[0]
+    k = leaf_feats.shape[1]
+    d = k + 1
+    z, row_ok = _gather_z(x, leaf_id, leaf_feats)
+    w = jnp.where(row_ok, row_weight, 0.0).astype(jnp.float32)
+    wh = w * hess.astype(jnp.float32)
+    wg = w * grad.astype(jnp.float32)
+    live_row = (w > 0).astype(jnp.float32)
+    lids = jnp.arange(num_leaves, dtype=leaf_id.dtype)
+
+    def contract(lo, rows):
+        """One row-chunk's [L, d*d] / [L, d] / [L] sums."""
+        zc = jax.lax.dynamic_slice(z, (lo, 0), (rows, d))
+        oh = (jax.lax.dynamic_slice(leaf_id, (lo,), (rows,))[:, None]
+              == lids[None, :]).astype(jnp.float32)        # [rows, L]
+        whc = jax.lax.dynamic_slice(wh, (lo,), (rows,))
+        wgc = jax.lax.dynamic_slice(wg, (lo,), (rows,))
+        cntc = jax.lax.dynamic_slice(live_row, (lo,), (rows,))
+        zz = (zc[:, :, None] * zc[:, None, :]).reshape(rows, d * d)
+        a = jnp.einsum("nl,nm->lm", oh * whc[:, None], zz,
+                       preferred_element_type=jnp.float32,
+                       precision=jax.lax.Precision.HIGHEST)
+        b = jnp.einsum("nl,nm->lm", oh * wgc[:, None], zc,
+                       preferred_element_type=jnp.float32,
+                       precision=jax.lax.Precision.HIGHEST)
+        cnt = jnp.einsum("nl,n->l", oh, cntc,
+                         preferred_element_type=jnp.float32,
+                         precision=jax.lax.Precision.HIGHEST)
+        return a, b, cnt
+
+    if n <= chunk or n % chunk != 0:
+        a_sum, b_sum, cnt = contract(jnp.int32(0), n)
+    else:
+        def body(c, acc):
+            a, b, cnt = contract(c * chunk, chunk)
+            return acc[0] + a, acc[1] + b, acc[2] + cnt
+        a_sum, b_sum, cnt = jax.lax.fori_loop(
+            0, n // chunk, body,
+            (jnp.zeros((num_leaves, d * d), jnp.float32),
+             jnp.zeros((num_leaves, d), jnp.float32),
+             jnp.zeros((num_leaves,), jnp.float32)))
+
+    a_mat = a_sum.reshape(num_leaves, d, d)
+    # ridge on the feature diagonal; padded slots (feature -1) have an
+    # all-zero row/column — pin their diagonal to 1 so the batched
+    # solve stays nonsingular and returns exactly 0 for them
+    slot_pad = (leaf_feats < 0)                               # [L, k]
+    diag = jnp.concatenate(
+        [jnp.where(slot_pad, 1.0,
+                   jnp.asarray(linear_lambda, jnp.float32)),
+         jnp.zeros((num_leaves, 1), jnp.float32)], axis=1)    # [L, d]
+    # fallback leaves (incl. dead slots with zero rows) get an identity
+    # system so the batched solve never sees a singular operand
+    enough = cnt >= 2.0 * d
+    a_mat = a_mat + diag[:, :, None] * jnp.eye(d, dtype=jnp.float32)
+    eye = jnp.broadcast_to(jnp.eye(d, dtype=jnp.float32),
+                           (num_leaves, d, d))
+    a_mat = jnp.where(enough[:, None, None], a_mat, eye)
+    beta = jnp.linalg.solve(a_mat, -b_sum[:, :, None])[:, :, 0]  # [L, d]
+    fitted = enough & jnp.all(jnp.isfinite(beta), axis=1)
+    leaf_coeff = jnp.where(fitted[:, None] & ~slot_pad,
+                           beta[:, :k], 0.0)
+    leaf_value = jnp.where(fitted, beta[:, k],
+                           leaf_const.astype(jnp.float32))
+    return leaf_value, leaf_coeff, fitted
+
+
+def linear_row_values(x, leaf_id, leaf_value, leaf_coeff, leaf_feats):
+    """Per-row raw score under piecewise-linear leaves.
+
+    value(r) = leaf_value[l] + row_ok * sum_j coeff[l, j] * x[r, f_j]
+    with l = leaf_id[r]; a row with any non-finite used feature gets
+    the intercept only (the fit excluded it the same way). Traceable —
+    the training score update, valid-score update and rollback all
+    route through here so every path applies identical semantics."""
+    num_f = x.shape[1]
+    feats = leaf_feats[leaf_id]                        # [N, k]
+    pad = feats < 0
+    xv = jnp.take_along_axis(x, jnp.clip(feats, 0, num_f - 1), axis=1)
+    finite = jnp.isfinite(xv) | pad
+    row_ok = jnp.all(finite, axis=1)
+    xv = jnp.where(pad | ~finite, 0.0, xv)
+    lin = jnp.einsum("nk,nk->n", leaf_coeff[leaf_id].astype(jnp.float32),
+                     xv.astype(jnp.float32),
+                     precision=jax.lax.Precision.HIGHEST)
+    return leaf_value[leaf_id] + jnp.where(row_ok, lin, 0.0)

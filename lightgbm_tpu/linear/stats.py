@@ -1,0 +1,47 @@
+"""Per-leaf marginal regression moments from the histogram kernels.
+
+The fused per-bin moment pass (`ops/histogram.leaf_moments` family:
+sum x, sum x^2, sum x*g, sum x*h per (feature, bin), accumulated
+alongside grad/hess in the same chunk/group-block schedule) yields,
+summed over bins, exactly the MARGINAL entries of the solver's normal
+equations: for leaf l and feature f,
+
+    A[f, f]         = sum_l w h x_f^2   <- sum over bins of moments[..2]
+                      is sum w g x_f; the diagonal hessian moment rides
+                      in channel 3 (sum x*h) only for h-weighted x —
+                      see below for exactly which entries close.
+    A[f, intercept] = sum w h x_f       <- NOT a marginal channel; the
+                      per-bin channels close over (m, g, h) weights of
+                      x and x^2, so the cross-moment sum w h x_i x_j
+                      (i != j) is NOT recoverable from per-bin marginals
+                      — which is why linear/solver.py builds its normal
+                      equations in its own design pass.
+
+What IS exact, and what the bit-identity tests assert: the solver's
+b-vector entries (sum w g x_f) and the mask/count-weighted sums
+(sum w x_f, sum w x_f^2, sum w h x_f) equal the bin-summed moment
+channels for every (leaf, feature) — one cross-check per channel,
+tying the fused histogram extension to the solver's independent
+contraction.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ops.histogram import batched_leaves_moments
+
+
+def leaf_feature_moments(binned, x, weights, leaf_id, ids, num_bins,
+                         chunk: int = 16384, n_valid=None):
+    """Per-(leaf, feature) marginal moments, summed over bins.
+
+    Thin aggregation over `ops/histogram.batched_leaves_moments`:
+    returns [C, F, 4] = (sum w x, sum w x^2, sum w g x, sum w h x) per
+    leaf id and feature column — the diagnostics surface the linear
+    solver's tests cross-validate against (the off-diagonal
+    cross-moments of the normal equations are deliberately absent; see
+    the module docstring)."""
+    per_bin = batched_leaves_moments(binned, x, weights, leaf_id,
+                                     jnp.asarray(ids), num_bins,
+                                     chunk=chunk, n_valid=n_valid)
+    return per_bin.sum(axis=2)

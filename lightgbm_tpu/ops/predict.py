@@ -51,6 +51,13 @@ class DeviceTree(NamedTuple):
     cat_bitset: jnp.ndarray            # [W] u32 raw-value bitset words
     cat_boundaries_inner: jnp.ndarray  # [C+1] i32
     cat_bitset_inner: jnp.ndarray      # [W'] u32 bin-space bitset words
+    # piecewise-linear leaves (linear/): zero-width (k = 0) for
+    # constant-leaf trees. Feature indices follow split_feature's space
+    # (inner for binned stacks, original columns after stack_trees_raw /
+    # to_device_raw); the linear term needs RAW feature values, so only
+    # the raw-space value paths can evaluate it.
+    leaf_coeff: jnp.ndarray = None     # [L, k] f32 slopes
+    leaf_feat: jnp.ndarray = None      # [L, k] i32 columns, -1-padded
 
 
 def _in_bitset(boundaries, bitset, cat_idx, value):
@@ -148,12 +155,48 @@ def predict_leaf_raw(tree: DeviceTree, data: jnp.ndarray) -> jnp.ndarray:
     return ~node
 
 
+def _is_linear_tree(tree: DeviceTree) -> bool:
+    """Static (trace-time) check for a linear-leaf tree/stack."""
+    return tree.leaf_coeff is not None and tree.leaf_coeff.shape[-1] > 0
+
+
+def linear_leaf_addend(leaf_coeff, leaf_feat, leaf, data):
+    """[N] linear-leaf contribution: sum_j coeff[l, j] * x[r, f_j] with
+    l = leaf[r]. Padded slots (-1) contribute a structural zero; a row
+    with a non-finite value in any live slot gets 0 (intercept only) —
+    the solver excluded such rows from the fit the same way, so train
+    and serve agree (linear/solver.py)."""
+    feats = leaf_feat[leaf]                                   # [N, k]
+    pad = feats < 0
+    xv = jnp.take_along_axis(
+        data, jnp.clip(feats, 0, data.shape[1] - 1), axis=1)
+    finite = jnp.isfinite(xv) | pad
+    row_ok = jnp.all(finite, axis=1)
+    xv = jnp.where(pad | ~finite, 0.0, xv)
+    lin = jnp.einsum("nk,nk->n", leaf_coeff[leaf].astype(jnp.float32),
+                     xv.astype(jnp.float32),
+                     precision=jax.lax.Precision.HIGHEST)
+    return jnp.where(row_ok, lin, 0.0)
+
+
 def predict_value_binned(tree: DeviceTree, binned: jnp.ndarray) -> jnp.ndarray:
+    if _is_linear_tree(tree):
+        # the linear term contracts RAW feature values, which a binned
+        # matrix cannot reconstruct — callers route linear models
+        # through predict_leaf_binned + linear_leaf_addend on raw data
+        raise ValueError(
+            "binned value prediction cannot evaluate linear_tree leaves "
+            "(raw feature values required); use the leaf + raw path")
     return tree.leaf_value[predict_leaf_binned(tree, binned)]
 
 
 def predict_value_raw(tree: DeviceTree, data: jnp.ndarray) -> jnp.ndarray:
-    return tree.leaf_value[predict_leaf_raw(tree, data)]
+    leaf = predict_leaf_raw(tree, data)
+    val = tree.leaf_value[leaf]
+    if _is_linear_tree(tree):
+        val = val.astype(jnp.float32) + linear_leaf_addend(
+            tree.leaf_coeff, tree.leaf_feat, leaf, data)
+    return val
 
 
 def stack_trees(trees) -> DeviceTree:
@@ -167,6 +210,7 @@ def stack_trees(trees) -> DeviceTree:
     max_cat = max(t.num_cat for t in trees)
     max_w = max(max(len(t.cat_threshold), 1) for t in trees)
     max_wi = max(max(len(t.cat_threshold_inner), 1) for t in trees)
+    max_k = max(t.leaf_coeff.shape[1] for t in trees)
     fmax = np.finfo(np.float32).max
 
     def pad(get, size, dtype, fill=0):
@@ -174,6 +218,13 @@ def stack_trees(trees) -> DeviceTree:
         for i, t in enumerate(trees):
             arr = np.asarray(get(t))
             out[i, :len(arr)] = arr
+        return jnp.asarray(out)
+
+    def pad2(get, size, dtype, fill=0):
+        out = np.full((len(trees), size, max_k), fill, dtype)
+        for i, t in enumerate(trees):
+            arr = np.asarray(get(t))
+            out[i, :arr.shape[0], :arr.shape[1]] = arr
         return jnp.asarray(out)
 
     return DeviceTree(
@@ -216,19 +267,28 @@ def stack_trees(trees) -> DeviceTree:
                          t.cat_boundaries_inner[-1], np.int32)]),
             max_cat + 2, np.int32),
         cat_bitset_inner=pad(lambda t: t.cat_threshold_inner, max_wi, np.uint32),
+        # padding leaves get -1 features (structural zero contribution)
+        leaf_coeff=pad2(lambda t: t.leaf_coeff, max_l, np.float32),
+        leaf_feat=pad2(lambda t: t.leaf_features_inner, max_l, np.int32,
+                       fill=-1),
     )
 
 
 def stack_trees_raw(trees) -> DeviceTree:
     """Like stack_trees but with original-column feature indices for
-    raw-feature traversal."""
+    raw-feature traversal (split AND linear-leaf features)."""
     import numpy as np
     stacked = stack_trees(trees)
     max_m = stacked.split_feature.shape[1]
     out = np.zeros((len(trees), max_m), np.int32)
     for i, t in enumerate(trees):
         out[i, :len(t.split_feature)] = t.split_feature
-    return stacked._replace(split_feature=jnp.asarray(out))
+    lf = np.array(stacked.leaf_feat)  # writable host copy
+    for i, t in enumerate(trees):
+        nl, k = t.leaf_features.shape
+        lf[i, :nl, :k] = t.leaf_features
+    return stacked._replace(split_feature=jnp.asarray(out),
+                            leaf_feat=jnp.asarray(lf))
 
 
 def predict_forest_binned(stacked: DeviceTree, binned: jnp.ndarray) -> jnp.ndarray:
@@ -293,6 +353,12 @@ class MatmulForest(NamedTuple):
     leaf_value: jnp.ndarray     # [T, L] f32
     is_cat: jnp.ndarray         # [T, M] bool
     cat_table: jnp.ndarray      # [T, V, M] f32 in {-1, 0, +1}
+    # piecewise-linear leaves: one leaf-gathered coeff . x contraction
+    # on top of the one-hot reduction; k = 0 for constant forests (the
+    # static gate) and the gathered coefficients of padding trees/leaves
+    # are 0, so they contribute nothing
+    leaf_feat: jnp.ndarray      # [T, L, k] i32 original columns, -1 pad
+    leaf_coeff: jnp.ndarray     # [T, L, k] f32
     # forest-level expansion spec [Fc] (NOT per-tree; excluded from
     # _tree_batches' per-tree reshape and from the scan xs)
     cat_cols: jnp.ndarray       # [Fc] i32 original column
@@ -353,6 +419,9 @@ def stack_trees_matmul(trees):
     lval = np.zeros((T, max_l), np.float32)
     is_cat = np.zeros((T, max_m), bool)
     cat_table = np.zeros((T, v_total, max_m), np.float32)
+    max_k = max(t.leaf_coeff.shape[1] for t in trees)
+    lfeat = np.full((T, max_l, max_k), -1, np.int32)
+    lcoef = np.zeros((T, max_l, max_k), np.float32)
 
     for t_i, t in enumerate(trees):
         m = max(t.num_leaves - 1, 0)
@@ -361,6 +430,10 @@ def stack_trees_matmul(trees):
         dleft[t_i, :m] = [t.default_left_node(i) for i in range(m)]
         miss[t_i, :m] = t.node_missing[:m]
         lval[t_i, :t.num_leaves] = t.leaf_value
+        nl_k = t.leaf_coeff.shape[1]
+        if nl_k:
+            lfeat[t_i, :t.num_leaves, :nl_k] = t.leaf_features
+            lcoef[t_i, :t.num_leaves, :nl_k] = t.leaf_coeff
         for i in range(m):
             if not t.is_categorical_node(i):
                 continue
@@ -406,7 +479,8 @@ def stack_trees_matmul(trees):
         cat_off=jnp.asarray([offs[f] for f in cat_cols], jnp.int32)
         if cat_cols else jnp.zeros(0, jnp.int32),
         cat_card=jnp.asarray([cards[f] for f in cat_cols], jnp.int32)
-        if cat_cols else jnp.zeros(0, jnp.int32))
+        if cat_cols else jnp.zeros(0, jnp.int32),
+        leaf_feat=jnp.asarray(lfeat), leaf_coeff=jnp.asarray(lcoef))
 
 
 def _cat_expansion(mf: MatmulForest, nan_mask, clean):
@@ -518,6 +592,8 @@ def predict_forest_raw_matmul(mf: MatmulForest, data: jnp.ndarray,
     clean = jnp.where(nan_mask, 0.0, data)
     expanded = _cat_expansion(mf, nan_mask, clean)
     batched = _tree_batches(mf, tree_batch)
+    linear = mf.leaf_coeff.shape[-1] > 0
+    lidx = jnp.arange(mf.leaf_value.shape[1], dtype=jnp.float32)
 
     def body(acc, trees):
         def one(tree):
@@ -525,10 +601,23 @@ def predict_forest_raw_matmul(mf: MatmulForest, data: jnp.ndarray,
             # HIGHEST: one-hot x f32 leaf values stay exact (default
             # bf16 inputs would truncate the leaf values); the f32 cast
             # upcasts f16-stored leaves of quantized layouts losslessly
-            return jnp.einsum("nl,l->n", match.astype(jnp.float32),
-                              tree.leaf_value.astype(jnp.float32),
-                              preferred_element_type=jnp.float32,
-                              precision=jax.lax.Precision.HIGHEST)
+            val = jnp.einsum("nl,l->n", match.astype(jnp.float32),
+                             tree.leaf_value.astype(jnp.float32),
+                             preferred_element_type=jnp.float32,
+                             precision=jax.lax.Precision.HIGHEST)
+            if linear:
+                # leaf-gathered coeff . x contraction: recover the leaf
+                # index from the one-hot match (HIGHEST — indices > 256
+                # must stay exact), then gather that leaf's slope table.
+                # Padding trees/leaves carry zero coefficients, so they
+                # add exactly 0 here just as they do in the value einsum
+                lid = jnp.einsum("nl,l->n", match.astype(jnp.float32),
+                                 lidx, preferred_element_type=jnp.float32,
+                                 precision=jax.lax.Precision.HIGHEST
+                                 ).astype(jnp.int32)
+                val = val + linear_leaf_addend(
+                    tree.leaf_coeff, tree.leaf_feat, lid, data)
+            return val
 
         return acc + jax.vmap(one)(trees).sum(axis=0), None
 
@@ -649,8 +738,13 @@ def stack_trees_quant(trees):
     the [T, M, L] path tensor / categorical expansion exceeds the
     shared device-memory budgets (callers then fall back to the walk
     layout with f16 leaves). Raises QuantRefused when any feature uses
-    more than QUANT_MAX_CODES distinct thresholds."""
+    more than QUANT_MAX_CODES distinct thresholds, and for linear_tree
+    forests (no quantized coefficient layout is designed yet)."""
     import numpy as np
+    if any(t.is_linear for t in trees):
+        raise QuantRefused(
+            "linear_tree leaf coefficients have no int8 layout; "
+            "predict linear forests with tpu_predict_quantize=none (f32)")
     base = stack_trees_matmul(trees)
 
     # per-feature threshold grids + missing types (missing type is a

@@ -133,6 +133,13 @@ def create_tree_digraph(booster, tree_index=0, show_info=None, name=None,
         booster = booster.booster_
     if not isinstance(booster, Booster):
         raise TypeError("booster must be Booster or LGBMModel")
+    if any(getattr(t, "is_linear", False)
+           for t in getattr(booster._inner, "models", ())):
+        raise LightGBMError(
+            "create_tree_digraph/plot_tree do not render linear_tree "
+            "models: leaf nodes carry per-leaf regressions, not the "
+            "single constant the digraph labels show; dump_model() "
+            "exposes the leaf_features/leaf_coeff tables instead")
     model = booster.dump_model()
     tree_infos = model["tree_info"]
     if tree_index >= len(tree_infos):

@@ -435,8 +435,19 @@ def _stacks_to_f16(mf, st):
     type, `missing` is nulled out so the eval kernel
     (ops/predict.predict_forest_f16) skips the NaN-mask selection
     einsum and missing-resolution chain outright — categorical nodes
-    resolve NaN through the block expansion regardless."""
+    resolve NaN through the block expansion regardless.
+
+    linear_tree forests refuse (QuantRefused, surfaced by the gbdt
+    accuracy-gate wrapper as a named LightGBMError): coefficient tables
+    have no designed f16 storage contract yet, and silently truncating
+    slopes would break the train/serve agreement."""
     import jax.numpy as jnp
+    from ..ops.predict import QuantRefused
+    if any(x is not None and x.leaf_coeff is not None
+           and x.leaf_coeff.shape[-1] > 0 for x in (mf, st)):
+        raise QuantRefused(
+            "linear_tree leaf coefficients have no f16 layout; "
+            "predict linear forests with tpu_predict_quantize=none (f32)")
     if mf is not None:
         numeric_missing = np.asarray(mf.missing)[~np.asarray(mf.is_cat)]
         clean = not numeric_missing.any()

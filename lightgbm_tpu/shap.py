@@ -168,6 +168,14 @@ def predict_contrib(booster, data: np.ndarray, num_iteration: int = -1) -> np.nd
     total = len(booster.models)
     if num_iteration > 0:
         total = min(total, num_iteration * k)
+    if any(getattr(booster.models[i], "is_linear", False)
+           for i in range(total)):
+        from . import log
+        raise log.LightGBMError(
+            "predict_contrib does not support linear_tree models: the "
+            "TreeSHAP recursion attributes constant leaf outputs only "
+            "and would silently drop the per-leaf linear terms; use "
+            "predict() or retrain with linear_tree=false")
     out = np.zeros((n, k, nf + 1))
     for i in range(total):
         tree = booster.models[i]

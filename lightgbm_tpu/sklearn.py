@@ -73,6 +73,7 @@ class LGBMModel(_SKBase):
                  min_child_samples: int = 20, subsample: float = 1.0,
                  subsample_freq: int = 0, colsample_bytree: float = 1.0,
                  reg_alpha: float = 0.0, reg_lambda: float = 0.0,
+                 linear_tree: bool = False, linear_lambda: float = 0.0,
                  random_state: Optional[int] = None, n_jobs: int = -1,
                  silent: bool = True, **kwargs):
         self.boosting_type = boosting_type
@@ -91,6 +92,8 @@ class LGBMModel(_SKBase):
         self.colsample_bytree = colsample_bytree
         self.reg_alpha = reg_alpha
         self.reg_lambda = reg_lambda
+        self.linear_tree = linear_tree
+        self.linear_lambda = linear_lambda
         self.random_state = random_state
         self.n_jobs = n_jobs
         self.silent = silent
@@ -115,6 +118,8 @@ class LGBMModel(_SKBase):
             "subsample": self.subsample, "subsample_freq": self.subsample_freq,
             "colsample_bytree": self.colsample_bytree,
             "reg_alpha": self.reg_alpha, "reg_lambda": self.reg_lambda,
+            "linear_tree": self.linear_tree,
+            "linear_lambda": self.linear_lambda,
             "random_state": self.random_state, "n_jobs": self.n_jobs,
             "silent": self.silent,
         }
@@ -149,6 +154,8 @@ class LGBMModel(_SKBase):
             "feature_fraction": self.colsample_bytree,
             "lambda_l1": self.reg_alpha,
             "lambda_l2": self.reg_lambda,
+            "linear_tree": self.linear_tree,
+            "linear_lambda": self.linear_lambda,
             "verbose": -1 if self.silent else 1,
         }
         if self.random_state is not None:

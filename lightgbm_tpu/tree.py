@@ -73,6 +73,12 @@ class Tree:
         self.node_offset = np.zeros(m, np.int32)
         self.node_bundled = np.zeros(m, bool)
         self.node_num_bin = np.zeros(m, np.int32)
+        # piecewise-linear leaves (linear_tree=true): per-leaf slope
+        # tables [L, k]; k=0 marks a constant-leaf tree. Feature slots
+        # are -1-padded; leaf_value doubles as the fitted intercept.
+        self.leaf_coeff = np.zeros((num_leaves, 0), np.float64)
+        self.leaf_features = np.full((num_leaves, 0), -1, np.int32)        # original columns
+        self.leaf_features_inner = np.full((num_leaves, 0), -1, np.int32)  # used-feature space
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -134,6 +140,7 @@ class Tree:
             t.leaf_value[0] = float(np.asarray(state.leaf_value)[0])
             cnt = np.asarray(state.count)
             t.leaf_count[0] = int(cnt[0])
+            t._take_linear(state, dataset, nl)
             return t
         feat = np.asarray(state.node_feature)[:m]
         thr = np.asarray(state.node_threshold)[:m]
@@ -178,7 +185,23 @@ class Tree:
             dt |= {MISSING_NONE: 0, MISSING_ZERO: 1 << 2, MISSING_NAN: 2 << 2}[
                 mapper.missing_type]
             t.decision_type[i] = dt
+        t._take_linear(state, dataset, nl)
         return t
+
+    def _take_linear(self, state, dataset, nl: int) -> None:
+        """Adopt linear-leaf tables from a grower state (duck-typed:
+        constant-leaf states simply lack the attributes)."""
+        coeff = getattr(state, "leaf_coeff", None)
+        if coeff is None:
+            return
+        coeff = np.asarray(coeff)[:nl].astype(np.float64)
+        inner = np.asarray(
+            getattr(state, "leaf_features_inner"))[:nl].astype(np.int32)
+        self.leaf_coeff = coeff
+        self.leaf_features_inner = inner
+        self.leaf_features = np.asarray(
+            [[dataset.real_feature_index(int(j)) if j >= 0 else -1
+              for j in row] for row in inner], np.int32).reshape(inner.shape)
 
     # ------------------------------------------------------------------
     def attach_bin_metadata(self, dataset) -> None:
@@ -227,9 +250,29 @@ class Tree:
                 np.concatenate([inner_sets.get(i, np.zeros(1, np.uint32))
                                 for i in range(self.num_cat)])
                 if self.num_cat else np.zeros(0, np.uint32))
+        if self.leaf_coeff.shape[1] > 0:
+            # linear leaves address the USED-feature (inner) space of
+            # whichever dataset training continues on — remap from the
+            # original column ids; a regressed-on feature that is
+            # trivial/absent here cannot be evaluated during replay
+            remap = np.full(self.leaf_features.shape, -1, np.int32)
+            for (r, c), real in np.ndenumerate(self.leaf_features):
+                if real < 0:
+                    continue
+                if int(real) not in inner_of:
+                    log.fatal("Loaded linear_tree model regresses on "
+                              "feature %d which is trivial/absent in "
+                              "the dataset" % int(real))
+                remap[r, c] = inner_of[int(real)]
+            self.leaf_features_inner = remap
         self.has_bin_metadata = True
 
     # ------------------------------------------------------------------
+    @property
+    def is_linear(self) -> bool:
+        """True when this tree carries piecewise-linear leaf models."""
+        return self.leaf_coeff.shape[1] > 0
+
     def is_categorical_node(self, i: int) -> bool:
         return bool(self.decision_type[i] & _CAT_MASK)
 
@@ -243,6 +286,7 @@ class Tree:
         """Reference: Tree::Shrinkage (tree.h:166-173)."""
         self.leaf_value *= rate
         self.internal_value *= rate
+        self.leaf_coeff *= rate
         self.shrinkage *= rate
 
     def add_bias(self, val: float) -> None:
@@ -292,6 +336,8 @@ class Tree:
             cat_bitset_inner=jnp.asarray(
                 self.cat_threshold_inner if len(self.cat_threshold_inner)
                 else np.zeros(1, np.uint32)),
+            leaf_coeff=jnp.asarray(self.leaf_coeff, jnp.float32),
+            leaf_feat=jnp.asarray(self.leaf_features_inner, jnp.int32),
         )
 
     def to_device_raw(self):
@@ -299,13 +345,30 @@ class Tree:
         column indices, decisions on real thresholds)."""
         dt = self.to_device()
         import jax.numpy as jnp
-        return dt._replace(split_feature=jnp.asarray(self.split_feature))
+        return dt._replace(split_feature=jnp.asarray(self.split_feature),
+                           leaf_feat=jnp.asarray(self.leaf_features))
 
     # ------------------------------------------------------------------
+    def _leaf_output(self, leaf: int, row: np.ndarray) -> float:
+        """Leaf value plus the linear term. A row with a non-finite value
+        in any live feature slot gets the intercept only (the solver
+        excluded such rows from the fit the same way)."""
+        val = float(self.leaf_value[leaf])
+        acc = 0.0
+        for j in range(self.leaf_coeff.shape[1]):
+            f = int(self.leaf_features[leaf, j])
+            if f < 0:
+                continue
+            fval = row[f]
+            if not np.isfinite(fval):
+                return val
+            acc += float(self.leaf_coeff[leaf, j]) * float(fval)
+        return val + acc
+
     def predict_row(self, row: np.ndarray) -> float:
         """Scalar reference traversal (tree.h:416-450) for testing/host paths."""
         if self.num_leaves <= 1:
-            return float(self.leaf_value[0])
+            return self._leaf_output(0, row)
         node = 0
         while node >= 0:
             fval = row[self.split_feature[node]]
@@ -323,7 +386,7 @@ class Tree:
                 else:
                     go_left = fval <= self.threshold[node]
             node = self.left_child[node] if go_left else self.right_child[node]
-        return float(self.leaf_value[~node])
+        return self._leaf_output(~node, row)
 
     # ------------------------------------------------------------------
     # text model format (reference: Tree::ToString, tree.cpp:208-260)
@@ -367,6 +430,16 @@ class Tree:
                 str(int(x)) for x in self.cat_boundaries_inner[:self.num_cat + 1]))
             out.append("tpu_cat_threshold_inner=" + " ".join(
                 str(int(x)) for x in self.cat_threshold_inner))
+        if self.is_linear:
+            # piecewise-linear leaf tables, flattened row-major [L, k];
+            # repr() keeps the f64 coefficients round-trip exact
+            out.append(f"tpu_linear_k={self.leaf_coeff.shape[1]}")
+            out.append("tpu_leaf_features=" + " ".join(
+                str(int(x)) for x in self.leaf_features.ravel()))
+            out.append("tpu_leaf_features_inner=" + " ".join(
+                str(int(x)) for x in self.leaf_features_inner.ravel()))
+            out.append("tpu_leaf_coeff=" + " ".join(
+                repr(float(x)) for x in self.leaf_coeff.ravel()))
         return "\n".join(out) + "\n"
 
     @classmethod
@@ -433,6 +506,16 @@ class Tree:
         t.leaf_value = arr("leaf_value", np.float64, nl)
         t.leaf_count = arr("leaf_count", np.int64, nl)
         t.shrinkage = float(kv.get("shrinkage", 1.0))
+        k = int(kv.get("tpu_linear_k", 0))
+        if k > 0:
+            t.leaf_features = arr(
+                "tpu_leaf_features", np.int32, nl * k, default=-1
+            ).reshape(nl, k)
+            t.leaf_features_inner = arr(
+                "tpu_leaf_features_inner", np.int32, nl * k, default=-1
+            ).reshape(nl, k)
+            t.leaf_coeff = arr(
+                "tpu_leaf_coeff", np.float64, nl * k).reshape(nl, k)
         return t
 
     # ------------------------------------------------------------------
@@ -441,9 +524,16 @@ class Tree:
         def node_json(idx: int) -> dict:
             if idx < 0:
                 leaf = ~idx
-                return {"leaf_index": int(leaf),
-                        "leaf_value": float(self.leaf_value[leaf]),
-                        "leaf_count": int(self.leaf_count[leaf])}
+                d = {"leaf_index": int(leaf),
+                     "leaf_value": float(self.leaf_value[leaf]),
+                     "leaf_count": int(self.leaf_count[leaf])}
+                if self.is_linear:
+                    live = self.leaf_features[leaf] >= 0
+                    d["leaf_features"] = [
+                        int(f) for f in self.leaf_features[leaf][live]]
+                    d["leaf_coeff"] = [
+                        float(c) for c in self.leaf_coeff[leaf][live]]
+                return d
             if self.is_categorical_node(idx):
                 thr = "||".join(str(c) for c in self.cat_values(idx))
             else:

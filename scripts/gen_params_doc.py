@@ -248,6 +248,18 @@ DESCRIPTIONS = {
                          "categorical split",
     "histogram_pool_size": "kept for API compat (the TPU grower keeps "
                            "its histogram cache on device)",
+    "linear_tree": "piecewise-linear leaves: fit a ridge regression "
+                   "per leaf over the features split on along the "
+                   "leaf's root path, replacing the constant output "
+                   "with intercept + coeff . x (requires raw feature "
+                   "values; keep_raw is armed automatically)",
+    "linear_lambda": "linear_tree: L2 on the fitted slopes (the "
+                     "intercept is never penalized)",
+    "tpu_linear_max_features": "linear_tree: per-leaf design width cap "
+                               "— the first N distinct root-path split "
+                               "features, nearest the leaf first (the "
+                               "static [leaves, N] shape the linear "
+                               "kernels compile against)",
     "gpu_platform_id": "kept for API compat (no OpenCL here)",
     "gpu_device_id": "kept for API compat",
     "gpu_use_dp": "kept for API compat",
