@@ -169,7 +169,8 @@ class _HostState:
 
 
 def _grow_and_update_impl(score, binned, grad, hess, row_weight, fmask,
-                          shrinkage, n_valid, fmeta_args, cls, cfg):
+                          shrinkage, n_valid, fmeta_args, cls, cfg,
+                          qscale=None):
     """grow one tree + train-score update, fused into ONE device program.
 
     On a relay-attached TPU every eager op dispatch is a host round trip;
@@ -180,7 +181,7 @@ def _grow_and_update_impl(score, binned, grad, hess, row_weight, fmask,
     import jax.numpy as jnp
 
     state = grow_tree(binned, grad, hess, row_weight, fmask, *fmeta_args,
-                      cfg, n_valid=n_valid)
+                      cfg, n_valid=n_valid, qscale=qscale)
     grew = state.num_leaves_used > 1
     leaf_vals = state.leaf_value * shrinkage
     delta = jnp.where(
@@ -192,7 +193,7 @@ def _grow_and_update_impl(score, binned, grad, hess, row_weight, fmask,
 
 
 def _grow_and_update(score, binned, grad, hess, row_weight, fmask,
-                     shrinkage, n_valid, fmeta_args, cls, cfg):
+                     shrinkage, n_valid, fmeta_args, cls, cfg, qscale=None):
     import jax
     import jax.numpy as jnp
     global _grow_and_update_jit
@@ -202,7 +203,7 @@ def _grow_and_update(score, binned, grad, hess, row_weight, fmask,
     return _grow_and_update_jit(score, binned, grad, hess, row_weight,
                                 fmask, jnp.float32(shrinkage),
                                 jnp.int32(n_valid), tuple(fmeta_args),
-                                cls=cls, cfg=cfg)
+                                qscale=qscale, cls=cls, cfg=cfg)
 
 
 _grow_and_update_jit = None
@@ -253,7 +254,8 @@ _fit_linear_jit = None
 
 
 def _grow_and_update_multi_impl(score, binned, grads, hesses, row_weight,
-                                fmasks, shrinkage, n_valid, fmeta_args, cfg):
+                                fmasks, shrinkage, n_valid, fmeta_args, cfg,
+                                qscales=None):
     """Grow ALL num_class trees of one boosting iteration in ONE device
     program (vmap over the class axis) and update every score row.
 
@@ -266,11 +268,15 @@ def _grow_and_update_multi_impl(score, binned, grads, hesses, row_weight,
     import jax
     import jax.numpy as jnp
 
-    def one(g, h, m):
+    def one(g, h, m, qs=None):
         return grow_tree(binned, g, h, row_weight, m, *fmeta_args,
-                         cfg, n_valid=n_valid)
+                         cfg, n_valid=n_valid, qscale=qs)
 
-    state = jax.vmap(one)(grads, hesses, fmasks)
+    if qscales is None:
+        state = jax.vmap(one)(grads, hesses, fmasks)
+    else:
+        # per-class dequant scales ride the class vmap with the grads
+        state = jax.vmap(one)(grads, hesses, fmasks, qscales)
 
     def upd(lv, lid, grew):
         vals = lv * shrinkage
@@ -284,7 +290,7 @@ def _grow_and_update_multi_impl(score, binned, grads, hesses, row_weight,
 
 
 def _grow_and_update_multi(score, binned, grads, hesses, row_weight, fmasks,
-                           shrinkage, n_valid, fmeta_args, cfg):
+                           shrinkage, n_valid, fmeta_args, cfg, qscales=None):
     import jax
     import jax.numpy as jnp
     global _grow_and_update_multi_jit
@@ -295,7 +301,8 @@ def _grow_and_update_multi(score, binned, grads, hesses, row_weight, fmasks,
                                       row_weight, fmasks,
                                       jnp.float32(shrinkage),
                                       jnp.int32(n_valid),
-                                      tuple(fmeta_args), cfg=cfg)
+                                      tuple(fmeta_args), qscales=qscales,
+                                      cfg=cfg)
 
 
 _grow_and_update_multi_jit = None
@@ -348,6 +355,77 @@ def _bagging_mask_device(seed: int, refresh_idx, n: int, n_pad: int,
             static_argnames=("n", "n_pad", "fraction", "seed"))
     return _bagging_mask_jit(jnp.int32(refresh_idx), seed=seed, n=n,
                              n_pad=n_pad, fraction=float(fraction))
+
+
+_quantize_iter_jit = None
+
+
+def _quantize_iter_device(grad, hess, row_weight, it, *, seed, n, qmax,
+                          hess_const):
+    """Quantize one iteration's [k, n_pad] gradient/hessian stack for the
+    low-precision histogram path (tpu_hist_quantize, ISSUE 20): one
+    device program per iteration, vmapped over the class axis.
+
+    Returns (q_grad, q_hess, w01, qscales): integer-valued [k, n_pad]
+    gradient/hessian codes in [-qmax, qmax], the 0/1 row weight (any
+    bagging/GOSS weighting is FOLDED INTO the codes — the grower's
+    grad*row_weight product then stays integer), and the [k, 3]
+    per-class dequantization scales. The rounding keys chain
+    fold_in(fold_in(fold_in(PRNGKey(seed), iteration), class), 0|1) —
+    structurally distinct from the bagging stream's
+    fold_in(PRNGKey(seed), refresh) draw, so sharing the base seed
+    cannot collide — and the uniform draw itself rides the serial (n,)
+    shape inside quantize_gradients (world-size invariance, same
+    rationale as _bagging_mask_impl)."""
+    import jax
+    import jax.numpy as jnp
+    global _quantize_iter_jit
+    if _quantize_iter_jit is None:
+        def impl(grad, hess, row_weight, it, *, seed, n, qmax, hess_const):
+            from ..ops.histogram import quantize_gradients
+            base = jax.random.fold_in(jax.random.PRNGKey(seed), it)
+
+            def one(g, h, cls_idx):
+                kc = jax.random.fold_in(base, cls_idx)
+                return quantize_gradients(
+                    g, h, row_weight, n=n, qmax=qmax,
+                    key_g=jax.random.fold_in(kc, 0),
+                    key_h=jax.random.fold_in(kc, 1),
+                    hess_const=hess_const)
+
+            k = grad.shape[0]
+            qg, qh, w01, qs = jax.vmap(one)(grad, hess,
+                                            jnp.arange(k, dtype=jnp.int32))
+            # w01 is class-independent (it only reads row_weight)
+            return qg, qh, w01[0], qs
+
+        _quantize_iter_jit = jax.jit(
+            impl, static_argnames=("seed", "n", "qmax", "hess_const"))
+    return _quantize_iter_jit(grad, hess, row_weight, jnp.int32(it),
+                              seed=seed, n=n, qmax=qmax,
+                              hess_const=hess_const)
+
+
+_gate_grow_jit = None
+
+
+def _gate_grow(binned, g, h, w, mask, fmeta, cfg, n_cal, qscale=None):
+    """One calibration tree for the train-time quantize gate: grow under
+    `cfg` and return (per-row leaf values, leaf-value table). Jitted with
+    the static cfg so the quantized and f32 variants each compile once."""
+    import jax
+    import jax.numpy as jnp
+    global _gate_grow_jit
+    if _gate_grow_jit is None:
+        def impl(binned, g, h, w, mask, n_valid, fmeta, cfg, qscale=None):
+            state = grow_tree(binned, g, h, w, mask, *fmeta, cfg,
+                              n_valid=n_valid, qscale=qscale)
+            lid = jnp.clip(state.leaf_id, 0, cfg.num_leaves - 1)
+            return state.leaf_value[lid], state.leaf_value
+
+        _gate_grow_jit = jax.jit(impl, static_argnames=("cfg",))
+    return _gate_grow_jit(binned, g, h, w, mask, jnp.int32(n_cal),
+                          tuple(fmeta), cfg=cfg, qscale=qscale)
 
 
 class GBDT:
@@ -711,10 +789,56 @@ class GBDT:
             # (Epsilon) keep the full-tile default.
             bundled = g_cnt < 0.8 * max(1, train_data.num_features)
             batch_k = 4 if (wide and bundled) else 12
+        # --- quantized-gradient training (tpu_hist_quantize, ISSUE 20) ---
+        from ..ops.histogram import TRAIN_QUANTIZE_MODES, train_qmax
+        quant_mode = str(self.config.tree.tpu_hist_quantize or "none").lower()
+        if quant_mode not in TRAIN_QUANTIZE_MODES:  # config validates; belt
+            raise log.LightGBMError(
+                "tpu_hist_quantize must be one of %s (got %r)"
+                % (TRAIN_QUANTIZE_MODES, quant_mode))
+        if quant_mode != "none" and nproc > 1:
+            raise log.LightGBMError(
+                "tpu_hist_quantize=%s does not support multi-host "
+                "training: the rounding-key stream and the calibration "
+                "gate are defined over the global row axis resident on "
+                "one process; train with tpu_hist_quantize=none"
+                % quant_mode)
+        # the integer range adapts to the row count so a full-column bin
+        # sum can never overflow the exact int32 accumulator domain
+        # (ops/histogram.train_qmax); precision degrades gracefully at
+        # extreme n and the gate below judges the result
+        quant_qmax = train_qmax(quant_mode, n) if quant_mode != "none" else 0
+        # constant-hessian detection enables the hessian-channel comm
+        # elision AND exact hessian codes (q_h == qmax * in_bag). GOSS is
+        # excluded: its amplification weights fold into the quantized
+        # codes, so in-bag hessians are not all equal
+        quant_hess_const = bool(
+            quant_mode != "none" and objective is not None
+            and objective.is_constant_hessian()
+            and self.config.boosting_type == "gbdt")
+        self._quant_mode = quant_mode
+        self._quant_qmax = quant_qmax
+        self._quant_hess_const = quant_hess_const
+        # the rounding-key base seed: data_random_seed is NOT sweep-
+        # variable (boosting/sweep.SWEEP_VARIABLE_PARAMS), so a vmapped
+        # sweep and a solo train of the same config derive identical
+        # key chains — the sweep==solo byte-identity contract holds
+        # under quantization too
+        self._quant_seed = int(self.config.io.data_random_seed)
+        if quant_mode == "int8" and "tpu_batch_k" not in self.config.raw_params:
+            # int8 contracts 3 channels per node id instead of the bf16
+            # hi+lo path's 5, so the same 128-lane MXU output tile (and,
+            # on CPU, the same one-hot operand materialization) covers
+            # 5/3 more leaves per pass. Widening the batch is free on
+            # correctness: quantized histograms live in the exact int32
+            # domain, where trees are bit-identical for ANY batch_k.
+            batch_k = max(1, (batch_k * 5) // 3)
         log.info("Schedule: groups=%d max_bin=%d wide=%s subtract=%s "
-                 "compact=%s@%.2f batch_k=%d table_mult=%d chunk=%d",
+                 "compact=%s@%.2f batch_k=%d table_mult=%d chunk=%d "
+                 "quantize=%s qmax=%d",
                  g_cnt, self._max_bins, wide, subtract, compact,
-                 compact_frac, batch_k, table_mult, self._chunk)
+                 compact_frac, batch_k, table_mult, self._chunk,
+                 quant_mode, quant_qmax)
         # execution-schedule summary for the telemetry run-log header
         # (telemetry/runlog.py): the knobs that explain this run's pass
         # economics, host-readable without re-deriving the auto-selection
@@ -734,6 +858,8 @@ class GBDT:
             "batch_k": int(batch_k), "table_mult": int(table_mult),
             "chunk": int(self._chunk), "rows": int(n),
             "rows_padded": int(n_pad),
+            "hist_quantize": quant_mode, "hist_qmax": int(quant_qmax),
+            "hist_hess_const": bool(quant_hess_const),
         }
         self._grower_cfg = GrowerConfig(
             num_leaves=self.config.tree.num_leaves,
@@ -752,6 +878,9 @@ class GBDT:
             min_data_in_leaf=self.config.tree.min_data_in_leaf,
             min_sum_hessian_in_leaf=self.config.tree.min_sum_hessian_in_leaf,
             max_depth=self.config.tree.max_depth,
+            hist_quantize=quant_mode,
+            hist_qmax=quant_qmax,
+            hist_hess_const=quant_hess_const,
             # the scatter schedule pads the stored-group axis to a
             # device multiple; the appended empty groups get 1-bin
             # width-plan entries HERE (the single source — the binned
@@ -842,6 +971,84 @@ class GBDT:
                 self._score = self._score + self.init_score_bias
                 log.info("Start training from score %f", self.init_score_bias)
         self._pending_bias = self.init_score_bias
+
+        # train-time accuracy gate (tpu_hist_quantize_tol): judge the
+        # quantized config on a calibration slice BEFORE any tree is
+        # grown — refuse a lossy setup instead of silently training with
+        # it. Runs after boost-from-average so the calibration gradients
+        # match the real iteration-0 score.
+        if quant_mode != "none":
+            self._hist_quant_gate()
+
+    def _hist_quant_gate(self) -> None:
+        """Setup-time gate for tpu_hist_quantize (the serving
+        `_quant_gate` pattern applied to TRAINING): grow one calibration
+        tree with the quantized pipeline and one with the f32 pipeline on
+        the leading row chunk, both serial/full-pass (schedule knobs off
+        so the comparison isolates quantization), and refuse the config
+        when the worst per-row leaf-value delta — relative to the f32
+        tree's leaf-value scale, floored at 1 — exceeds
+        `tpu_hist_quantize_tol`."""
+        import jax
+        import jax.numpy as jnp
+
+        from .. import telemetry, tracing
+        from ..learner.grow import FMETA_KEYS
+        from ..ops.histogram import quantize_gradients, train_qmax
+
+        mode = self._quant_mode
+        if self.objective is None:
+            log.debug("tpu_hist_quantize=%s: custom-objective training "
+                      "(explicit gradients) has no setup-time gradient "
+                      "source — skipping the calibration gate", mode)
+            return
+        c = min(self._n_pad, self._chunk)
+        n_cal = min(self._n, c)
+        binned_cal = self._binned[:c]
+        grad, hess = self._compute_gradients(self._score)
+        k = self.num_tree_per_iteration
+        g = grad.reshape(k, self._n_pad)[0, :c]
+        h = hess.reshape(k, self._n_pad)[0, :c]
+        w = (jnp.arange(c) < n_cal).astype(jnp.float32)
+        mask = jnp.asarray(np.ones(self._num_features_padded, bool))
+        fmeta = [self._fmeta[key] for key in FMETA_KEYS]
+        # serial full-pass schedule, small tree: the gate isolates the
+        # quantization delta (subtract/compact/scatter are separately
+        # pinned bit-transparent by the schedule tests)
+        cfg = self._grower_cfg._replace(
+            data_axis=None, feature_axis=None, voting=False,
+            hist_subtract=False, hist_compact=False,
+            num_leaves=min(31, self.config.tree.num_leaves))
+        qmax = train_qmax(mode, n_cal)
+        kc = jax.random.fold_in(
+            jax.random.fold_in(jax.random.PRNGKey(self._quant_seed), 0), 0)
+        q_g, q_h, w01, qscale = quantize_gradients(
+            g, h, w, n=n_cal, qmax=qmax,
+            key_g=jax.random.fold_in(kc, 0),
+            key_h=jax.random.fold_in(kc, 1),
+            hess_const=self._quant_hess_const)
+        vq, _ = _gate_grow(binned_cal, q_g, q_h, w01, mask, fmeta,
+                           cfg._replace(hist_quantize=mode, hist_qmax=qmax),
+                           n_cal, qscale=qscale)
+        vf, lv_f = _gate_grow(binned_cal, g, h, w, mask, fmeta,
+                              cfg._replace(hist_quantize="none", hist_qmax=0,
+                                           hist_hess_const=False), n_cal)
+        scale = max(float(jnp.max(jnp.abs(lv_f))), 1.0)
+        delta = float(jnp.max(jnp.abs(vq[:n_cal] - vf[:n_cal]))) / scale
+        telemetry.gauge_set("train/hist_quantize_gate_delta", delta)
+        tracing.counter("train/hist_quantize_gate_runs", 1)
+        log.debug("Hist-quantize gate (%s, qmax=%d): relative leaf-value "
+                  "delta %.3g on %d calibration rows", mode, qmax, delta,
+                  n_cal)
+        tol = float(self.config.tree.tpu_hist_quantize_tol)
+        if delta > tol:
+            raise log.LightGBMError(
+                "tpu_hist_quantize=%s refused: max calibration leaf-value "
+                "delta %.3g vs the f32 grower exceeds "
+                "tpu_hist_quantize_tol=%.3g (relative to the f32 tree's "
+                "leaf-value scale, %d calibration rows). Raise the "
+                "tolerance or train with tpu_hist_quantize=none."
+                % (mode, delta, tol, n_cal))
 
     def add_valid(self, valid_data: Dataset, name: str,
                   metric_names: Sequence[str] = ()) -> None:
@@ -940,7 +1147,7 @@ class GBDT:
             self._feature_rng, self.config.tree.feature_fraction,
             self.train_data.num_features, self._num_features_padded)
 
-    def _grow(self, grad, hess, row_weight, feature_mask):
+    def _grow(self, grad, hess, row_weight, feature_mask, qscale=None):
         """Dispatch one tree growth to the serial or distributed grower."""
         import jax.numpy as jnp
         # padding is a row-suffix only in single-process runs (multi-host
@@ -949,12 +1156,12 @@ class GBDT:
         if self._dist_grower is not None:
             return self._dist_grower(self._binned, grad, hess, row_weight,
                                      jnp.asarray(feature_mask), self._fmeta,
-                                     n_valid=nv)
+                                     n_valid=nv, qscale=qscale)
         from ..learner.grow import FMETA_KEYS
         return grow_tree(
             self._binned, grad, hess, row_weight, jnp.asarray(feature_mask),
             *[self._fmeta[k] for k in FMETA_KEYS], self._grower_cfg,
-            n_valid=nv)
+            n_valid=nv, qscale=qscale)
 
     # ------------------------------------------------------------------
     def _compute_gradients(self, score) -> Tuple:
@@ -1018,13 +1225,28 @@ class GBDT:
             bag = self._bagging_weights(self.iter_, grad, hess)
             row_weight = self._row_weight_from_bag(bag)
 
+        # quantized-gradient training: replace the f32 moments with
+        # integer codes + the 0/1 row weight for the grower; the RAW f32
+        # moments are kept for consumers whose math stays full-precision
+        # (the piecewise-linear leaf fit)
+        grad_f32, hess_f32, row_weight_f32 = grad, hess, row_weight
+        qscales = None
+        if getattr(self, "_quant_mode", "none") != "none":
+            with tracing.phase("boosting/quantize"):
+                grad, hess, row_weight, qscales = _quantize_iter_device(
+                    grad, hess, row_weight, self.iter_,
+                    seed=self._quant_seed, n=self._n,
+                    qmax=self._quant_qmax,
+                    hess_const=self._quant_hess_const)
+
         import jax
 
         from ..learner.grow import FMETA_KEYS
 
         if k > 1 and self._dist_grower is None:
             self._raise_if_nonfinite(probe, self.iter_)
-            return self._train_one_iter_multi(grad, hess, row_weight)
+            return self._train_one_iter_multi(grad, hess, row_weight,
+                                              qscales)
 
         import os
         if (self._dist_grower is None and k == 1 and not self.valid_sets
@@ -1032,7 +1254,7 @@ class GBDT:
                 and getattr(self, "_supports_pipeline", True)
                 and not os.environ.get("LGBM_TPU_NO_PIPELINE")):
             return self._train_one_iter_pipelined(grad, hess, row_weight,
-                                                  probe)
+                                                  probe, qscales)
         self._raise_if_nonfinite(probe, self.iter_)
 
         # leaving the pipelined path (explicit gradients, a valid set
@@ -1043,6 +1265,7 @@ class GBDT:
         could_split_any = False
         for cls in range(k):
             mask = self._feature_mask()
+            qs = None if qscales is None else qscales[cls]
             if getattr(self, "_linear", False):
                 # piecewise-linear leaves: plain grow (serial OR
                 # distributed), then the shared post-growth fit program
@@ -1051,11 +1274,15 @@ class GBDT:
                 # values (pre-shrinkage) for the score update
                 with tracing.phase("tree/grow"):
                     state = self._grow(grad[cls], hess[cls], row_weight,
-                                       mask)
+                                       mask, qscale=qs)
                 with tracing.phase("tree/linear_fit"):
+                    # the leaf regression consumes the RAW f32 moments:
+                    # quantization narrows the HISTOGRAM path only, the
+                    # fitted intercept/slope normal equations stay exact
                     leaf_value, leaf_coeff, feats, vals = _fit_linear_post(
-                        self._raw, grad[cls], hess[cls], row_weight,
-                        state, self.config.tree.linear_lambda,
+                        self._raw, grad_f32[cls], hess_f32[cls],
+                        row_weight_f32, state,
+                        self.config.tree.linear_lambda,
                         self._grower_cfg, self._linear_k)
                 with tracing.phase("tree/extract"):
                     small = {key: getattr(state, key)
@@ -1081,7 +1308,7 @@ class GBDT:
                         row_weight, jnp.asarray(mask), self.shrinkage_rate,
                         self._n,
                         [self._fmeta[key] for key in FMETA_KEYS], cls,
-                        self._grower_cfg)
+                        self._grower_cfg, qscale=qs)
                 with tracing.phase("tree/extract"):
                     host_state = _HostState(jax.device_get(small))
                     tree = Tree.from_grower_state(host_state,
@@ -1092,7 +1319,7 @@ class GBDT:
             else:
                 with tracing.phase("tree/grow"):
                     state = self._grow(grad[cls], hess[cls], row_weight,
-                                       mask)
+                                       mask, qscale=qs)
                 with tracing.phase("tree/extract"):
                     small = {key: getattr(state, key)
                              for key in _SMALL_STATE_KEYS}
@@ -1130,7 +1357,7 @@ class GBDT:
         return self._finish_iter(could_split_any)
 
     def _train_one_iter_pipelined(self, grad, hess, row_weight,
-                                  probe=None) -> bool:
+                                  probe=None, qscales=None) -> bool:
         """Serial-learner iteration with the tree fetch pipelined one
         iteration behind the device dispatch (see __init__ note). The
         stop/rollback decision therefore lags one iteration: a
@@ -1157,7 +1384,8 @@ class GBDT:
                 self._score, self._binned, grad[0], hess[0],
                 row_weight, jnp.asarray(mask), self.shrinkage_rate,
                 self._n, [self._fmeta[key] for key in FMETA_KEYS], 0,
-                self._grower_cfg)
+                self._grower_cfg,
+                qscale=None if qscales is None else qscales[0])
         # fetch + build the PREVIOUS tree while this one runs on device
         ok_prev = self._flush_pending()
         # stash the DISPATCH-TIME shrinkage (a learning-rate schedule
@@ -1224,12 +1452,18 @@ class GBDT:
             self.pass_log = []
         rows_contracted = float(getattr(host_state, "rows_contracted", 0.0))
         comm_elems = float(getattr(host_state, "comm_elems", 0.0))
+        # element count -> wire bytes: every exchanged histogram element
+        # is 4 bytes (f32, or the exact int32 domain under
+        # tpu_hist_quantize — where the constant-hessian channel elision
+        # already shrank comm_elems itself by red_ch/3)
+        comm_bytes = comm_elems * 4.0
         self.pass_log.append((int(host_state.num_passes),
                               int(host_state.next_free),
-                              rows_contracted, comm_elems))
+                              rows_contracted, comm_elems, comm_bytes))
         tracing.counter("tree/num_passes", int(host_state.num_passes))
         tracing.counter("tree/rows_contracted", rows_contracted)
         tracing.counter("tree/comm_elems", comm_elems)
+        tracing.counter("tree/comm_bytes", comm_bytes)
 
     def _flush_pending(self) -> bool:
         """Materialize the pipelined tree, if any. Returns False when the
@@ -1376,7 +1610,8 @@ class GBDT:
         self._stopped = False
         return False
 
-    def _train_one_iter_multi(self, grad, hess, row_weight) -> bool:
+    def _train_one_iter_multi(self, grad, hess, row_weight,
+                              qscales=None) -> bool:
         """All num_class trees of one iteration as ONE device program
         (serial learner; see _grow_and_update_multi_impl)."""
         import jax
@@ -1392,7 +1627,7 @@ class GBDT:
                 self._score, self._binned, grad, hess, row_weight,
                 jnp.asarray(masks), self.shrinkage_rate, self._n,
                 [self._fmeta[key] for key in FMETA_KEYS],
-                self._grower_cfg)
+                self._grower_cfg, qscales=qscales)
         with tracing.phase("tree/extract"):
             host = jax.device_get(small)
         could_split_any = False

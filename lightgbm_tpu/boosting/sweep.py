@@ -287,7 +287,14 @@ class SweepTrainer:
             self.cfg, objective, kc=self.kc, n=self.n, n_pad=self.n_pad,
             mode=mode, bag_freq=bag_freq,
             fmeta_args=tuple(gb._fmeta[k] for k in FMETA_KEYS),
-            small_keys=_SMALL_STATE_KEYS)
+            small_keys=_SMALL_STATE_KEYS,
+            # quantized-gradient statics from the lead init (the gate
+            # already ran inside lead.init; data_random_seed and the
+            # hess_const-deciding params are sweep-SHARED by the
+            # variable-params whitelist, so the lead's values hold for
+            # every member)
+            quant_seed=getattr(gb, "_quant_seed", 0),
+            quant_hess_const=getattr(gb, "_quant_hess_const", False))
 
         # all K models start from the lead's initial score (same
         # objective + dataset => same init_score / boost-from-average)

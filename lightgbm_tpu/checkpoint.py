@@ -96,6 +96,12 @@ _FINGERPRINT_EXCLUDE = {
     "tpu_predict_pipeline", "tpu_predict_quantize",
     "tpu_predict_quantize_tol", "tpu_predict_warmup_rows",
     "tpu_predict_micro_batch", "tpu_predict_micro_batch_window_ms",
+    # the train-side quantize GATE (ISSUE 20) only decides whether a
+    # lossy config is ACCEPTED at setup; once training is running the
+    # tolerance never touches the trajectory — a resumed run may
+    # tighten or relax it freely (the MODE itself is fingerprinted
+    # below)
+    "tpu_hist_quantize_tol",
     # exported-forest artifacts (ISSUE 16): exporting serializes the
     # already-trained forest for serving replicas — which layouts and
     # buckets get packed never feeds back into training numerics
@@ -127,6 +133,11 @@ _FINGERPRINT_INCLUDED = {
     "tpu_hist_chunk", "tpu_double_precision", "tpu_batch_k",
     "tpu_hist_bf16", "tpu_hist_subtract", "tpu_hist_compact",
     "tpu_compact_threshold", "tpu_hist_pallas",
+    # quantized-gradient training (ISSUE 20): stochastically-rounded
+    # integer gradients change every histogram sum and therefore every
+    # split — resume must never blend a quantized trajectory with an
+    # f32 one (the gate TOLERANCE is excluded above)
+    "tpu_hist_quantize",
     # nonfinite guard aborts the trajectory instead of continuing it
     "tpu_guard_nonfinite",
     # piecewise-linear leaves: the per-leaf design width changes every

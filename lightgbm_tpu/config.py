@@ -371,6 +371,25 @@ class TreeConfig:
     # redundantly). Trees are bit-identical either way; voting keeps its
     # elected-slice exchange and ignores this
     tpu_hist_reduce: str = "scatter"
+    # quantized-gradient training (ops/histogram.py + learner/grow.py):
+    # per-iteration grad/hess vectors scaled and stochastically rounded
+    # to narrow integers (deterministic per-(seed, iteration) rounding
+    # keys; the draw rides the serial (n,) shape so results are
+    # world-size-invariant), histograms accumulated in exact int32 off
+    # bf16 integer contractions — int8 contracts 3 channels instead of
+    # the f32 path's 5 (hi+lo), int16 keeps 5 but stays exact via
+    # base-256 digits. Split structure is guarded by the train-time
+    # accuracy gate below; under the data-parallel scatter schedule a
+    # constant-hessian objective additionally ships 2/3 the collective
+    # bytes per pass. "none" is bit-identical to the f32 path.
+    tpu_hist_quantize: str = "none"
+    # train-time accuracy gate for tpu_hist_quantize (the serving-side
+    # tpu_predict_quantize_tol pattern): at init, one calibration tree
+    # is grown quantized AND f32 on a leading row slice; if the max
+    # per-row leaf-value delta (relative to the f32 trees' value scale)
+    # exceeds this tolerance the config is REFUSED with a named error
+    # instead of silently training lossy
+    tpu_hist_quantize_tol: float = 0.5
     # RETIRED (accepted for compat, warns): the hand-written pallas
     # histogram kernel measured slower than XLA's own fusion of the
     # one-hot compare into the dot (14.4 vs 11.1 ms/pass at 2M x 28 x 64)
@@ -524,6 +543,10 @@ TPU_PARAM_SPEC = {
     "tpu_hist_compact": "bool",
     "tpu_compact_threshold": ("float", None, None),  # <= 0 disables
     "tpu_hist_reduce": ("choice", "scatter", "allreduce"),
+    # must mirror ops/histogram.TRAIN_QUANTIZE_MODES (kept literal so the
+    # table stays import-free and AST-readable)
+    "tpu_hist_quantize": ("choice", "none", "int16", "int8"),
+    "tpu_hist_quantize_tol": ("float>", 0.0),
     "tpu_hist_pallas": "bool",                       # retired, warns
     # piecewise-linear leaves
     "tpu_linear_max_features": ("int", 1, None),

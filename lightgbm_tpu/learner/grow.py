@@ -209,6 +209,24 @@ class GrowerConfig(NamedTuple):
     # up to a chunk multiple; >= 1.0 forces every pass through the
     # compacted path — useful for tests; <= 0 disables compaction)
     compact_fraction: float = 0.25
+    # quantized-gradient training (tpu_hist_quantize, ISSUE 20):
+    # "none" | "int16" | "int8". Quantized modes expect grad/hess already
+    # scaled + stochastically rounded to integer-valued f32 in
+    # [-hist_qmax, hist_qmax] (ops.histogram.quantize_gradients) with
+    # row_weight collapsed to the 0/1 in-bag indicator, and a [3] qscale
+    # passed to grow_tree; histograms then accumulate/reduce/subtract in
+    # int32 (order-invariant — scatter == serial bitwise) and dequantize
+    # to real units only at the split-scoring seam.
+    hist_quantize: str = "none"
+    # the quantizer's clip magnitude (ops.histogram.train_qmax) — static
+    # so the constant-hessian collective rebuild below can bake it in
+    hist_qmax: int = 0
+    # constant-hessian channel elision: when the quantizer's hess_const
+    # branch is active (q_h == hist_qmax * in_bag exactly), the hess
+    # channel of every data-axis histogram reduction is DERIVABLE from
+    # the count channel — reduce only (g, cnt) and rebuild h = qmax*cnt
+    # after the collective: 2/3 the psum/psum_scatter bytes per pass.
+    hist_hess_const: bool = False
 
 
 class GrowParams(NamedTuple):
@@ -613,7 +631,8 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
               fmeta_default_bin: jnp.ndarray, fmeta_is_cat: jnp.ndarray,
               fmeta_group: jnp.ndarray, fmeta_offset: jnp.ndarray,
               fmeta_is_bundled: jnp.ndarray,
-              cfg: GrowerConfig, n_valid=None, owned_feats=None, gp=None):
+              cfg: GrowerConfig, n_valid=None, owned_feats=None, gp=None,
+              qscale=None):
     """Grow one leaf-wise tree.
 
     Args:
@@ -639,11 +658,24 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         scalars; None rebuilds them from the static cfg (identical
         numerics). The vmapped sweep grower maps a [K] model axis over
         this argument (learner/sweep.py).
+      qscale: [3] f32 dequantization scale (g_scale, h_scale, 1.0) —
+        REQUIRED when cfg.hist_quantize != "none" (grad/hess/row_weight
+        must then be the quantizer's outputs, see GrowerConfig notes);
+        ignored in the f32 path so the "none" graph is unchanged.
     Returns: TreeGrowerState — the host wraps the node arrays and converts
       bin thresholds to raw-space values.
     """
     if gp is None:
         gp = GrowParams.from_config(cfg)
+    quant = cfg.hist_quantize != "none"
+    if quant and qscale is None:
+        raise ValueError(
+            "hist_quantize=%r needs the quantizer's qscale (pass the "
+            "[3] scale from ops.histogram.quantize_gradients)"
+            % cfg.hist_quantize)
+    if not quant:
+        qscale = None   # f32 path: keep the traced graph byte-identical
+    dequant = functools.partial(split_ops.dequantize_hist, qscale=qscale)
     n, g_cols = binned.shape
     L = cfg.num_leaves
     B = cfg.max_bins
@@ -710,6 +742,15 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     else:
         nv_local = jnp.minimum(n_valid, n)
 
+    # constant-hessian channel elision (quantized modes): q_h is exactly
+    # hist_qmax * in_bag per row, so the hess channel of every reduced
+    # histogram equals hist_qmax * count — ship only (g, cnt) through the
+    # collective and rebuild h afterwards. int32 makes the rebuild exact.
+    elide_hess = (quant and cfg.hist_hess_const
+                  and cfg.data_axis is not None and not voting)
+    # live channels per bin crossing the data-axis collective
+    red_ch = 2 if elide_hess else 3
+
     def reduce_hist(h, group_dim=0):
         """Data-axis reduction seam (the ReduceScatter of
         data_parallel_tree_learner.cpp:148-163). hist_scatter reduces
@@ -720,12 +761,18 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         psum. Voting mode keeps histograms LOCAL; only elected slices
         travel."""
         if cfg.data_axis is not None and not voting:
+            if elide_hess:
+                h = h[..., 0::2]                      # (g, cnt)
             if scatter:
                 h = jax.lax.psum_scatter(h, cfg.data_axis,
                                          scatter_dimension=group_dim,
                                          tiled=True)
             else:
                 h = jax.lax.psum(h, cfg.data_axis)
+            if elide_hess:
+                cnt = h[..., 1]
+                h = jnp.stack([h[..., 0], cfg.hist_qmax * cnt, cnt],
+                              axis=-1)
         return h
 
     w3 = jnp.stack([grad * row_weight, hess * row_weight,
@@ -780,7 +827,8 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     # --- root (BeforeTrain: serial_tree_learner.cpp:234-323) ------------
     local_root = hist_ops.leaf_histogram(local_binned, w3, B, cfg.chunk,
                                          bf16=cfg.hist_bf16, n_valid=nv_local,
-                                         group_widths=gw)
+                                         group_widths=gw,
+                                         quantize=cfg.hist_quantize)
     root_hist = reduce_hist(local_root)
     # global leaf sums: the reference Allreduces (cnt, sum_g, sum_h)
     # (data_parallel_tree_learner.cpp:117-145); summing any group's bins
@@ -794,33 +842,39 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         root_tot = jax.lax.psum(local_root[0].sum(axis=0), cfg.data_axis)
     elif scatter:
         owner0 = jax.lax.axis_index(cfg.data_axis) == 0
+        rt = root_hist[0].sum(axis=0)
         root_tot = jax.lax.psum(
-            jnp.where(owner0, root_hist[0].sum(axis=0), 0.0),
-            cfg.data_axis)
+            jnp.where(owner0, rt, jnp.zeros_like(rt)), cfg.data_axis)
     else:
         root_tot = root_hist[0].sum(axis=0)
+    # quantized modes: totals leave the exact integer domain HERE; every
+    # table aggregate / gain / leaf value downstream is real-unit f32
+    root_tot = dequant(root_tot)
     root_g, root_h, root_c = root_tot[0], root_tot[1], root_tot[2]
     root_comm = jnp.float32(0.0)
     if cfg.data_axis is not None:
         # per-device elements moved: voting ships 3 totals, scatter keeps
-        # one owned slice, the full psum replicates every group
+        # one owned slice, the full psum replicates every group (the
+        # constant-hessian elision drops the hess channel from the
+        # histogram tensor's transit: red_ch = 2)
         root_comm = jnp.float32(3.0 if voting
-                                else (gl * B * 3 + 3 if scatter
-                                      else fl * B * 3))
+                                else (gl * B * red_ch + 3 if scatter
+                                      else fl * B * red_ch))
 
+    root_hist_f = dequant(root_hist)
     if voting:
         root_vals, comm1 = _voting_children_best(
-            root_hist[None], root_g[None], root_h[None], root_c[None],
+            root_hist_f[None], root_g[None], root_h[None], root_c[None],
             jnp.zeros(1, jnp.int32), local_fmask, local_fmeta, cfg, gp)
         root_vals = tuple(v[0] for v in root_vals)
         root_comm = root_comm + comm1
     elif scatter:
         root_vals = _scattered_best_split(
-            root_hist, root_g, root_h, root_c, jnp.int32(0), local_fmask,
+            root_hist_f, root_g, root_h, root_c, jnp.int32(0), local_fmask,
             local_fmeta, owned, gs, cfg, gp)
     else:
         root_vals = _leaf_best_split(
-            root_hist, root_g, root_h, root_c, jnp.int32(0), local_fmask,
+            root_hist_f, root_g, root_h, root_c, jnp.int32(0), local_fmask,
             local_fmeta, cfg, gp)
 
     table = _NodeTable.zeros(M)
@@ -844,9 +898,12 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
 
     if subtract:
         # under hist_scatter the cache holds owned-slice histograms — the
-        # parent-minus-smaller identity is linear, so it holds slice-wise
+        # parent-minus-smaller identity is linear, so it holds slice-wise.
+        # Quantized modes cache the INT32 histograms: parent - child is
+        # then exact, so sum(left) + sum(right) == parent holds bitwise
+        # in the quantized domain (the ISSUE 20 parent-sum contract).
         hist_cache = jnp.zeros((M, own_g, B, 3),
-                               jnp.float32).at[0].set(root_hist)
+                               root_hist.dtype).at[0].set(root_hist)
     else:
         hist_cache = jnp.zeros((1,), jnp.float32)
 
@@ -1038,7 +1095,7 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                 return hist_ops.gathered_leaves_histogram(
                     local_binned, w3, leaf_id, rows_buf, hist_ids, B,
                     cfg.chunk, bf16=cfg.hist_bf16, n_valid=cnt,
-                    group_widths=gw)
+                    group_widths=gw, quantize=cfg.hist_quantize)
 
             hists = jax.lax.cond(
                 use_compact,
@@ -1046,7 +1103,7 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                 lambda _: hist_ops.batched_leaves_histogram(
                     local_binned, w3, leaf_id, hist_ids, B, cfg.chunk,
                     bf16=cfg.hist_bf16, n_valid=nv_local,
-                    group_widths=gw),
+                    group_widths=gw, quantize=cfg.hist_quantize),
                 None)
             rows_pass = jnp.where(use_compact, cnt.astype(jnp.float32),
                                   full_rows)
@@ -1054,7 +1111,7 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             hists = hist_ops.batched_leaves_histogram(
                 local_binned, w3, leaf_id, hist_ids, B, cfg.chunk,
                 bf16=cfg.hist_bf16, n_valid=nv_local,
-                group_widths=gw)
+                group_widths=gw, quantize=cfg.hist_quantize)
             rows_pass = full_rows
         # [C, G, B, 3]: the stored-group axis is dim 1
         hists = reduce_hist(hists, group_dim=1)
@@ -1083,14 +1140,17 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         all_c = jnp.concatenate([lcc, pc - lcc])
         all_d = jnp.concatenate([cdepth, cdepth])
 
+        # split scoring reads real-unit f32; the int32 histograms stay
+        # exact for the cache/subtraction identity above
+        hists_f = dequant(hists)
         comm = jnp.float32(0.0)
         if voting:
             vals2, comm = _voting_children_best(
-                hists, all_g, all_h, all_c, all_d,
+                hists_f, all_g, all_h, all_c, all_d,
                 local_fmask, local_fmeta, cfg, gp)
         else:
             if cfg.data_axis is not None:
-                comm = jnp.float32(red_c * own_g * B * 3)
+                comm = jnp.float32(red_c * own_g * B * red_ch)
             if scatter:
                 split_fn = jax.vmap(
                     lambda h, g, hh, c, d: _scattered_best_split(
@@ -1101,7 +1161,7 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                     lambda h, g, hh, c, d: _leaf_best_split(
                         h, g, hh, c, d, local_fmask, local_fmeta, cfg,
                         gp))
-            vals2 = split_fn(hists, all_g, all_h, all_c, all_d)
+            vals2 = split_fn(hists_f, all_g, all_h, all_c, all_d)
         gain2, feat2, thr2, dl2, cat2, lg2, lh2, lc2 = vals2
 
         idx = jnp.concatenate([cl_eff, cr_eff])              # [2K], M = drop
@@ -1376,6 +1436,9 @@ def schedule_summary(cfg: GrowerConfig) -> dict:
         "num_data_shards": int(cfg.num_data_shards),
         "num_groups": len(widths),
         "group_width_max": int(max(widths)) if widths else int(cfg.max_bins),
+        "hist_quantize": cfg.hist_quantize,
+        "hist_qmax": int(cfg.hist_qmax),
+        "hist_hess_const": bool(cfg.hist_hess_const),
     }
 
 

@@ -81,7 +81,8 @@ class SweepGrower:
 
     def __init__(self, cfg: GrowerConfig, objective, *, kc: int, n: int,
                  n_pad: int, mode: str, bag_freq: int,
-                 fmeta_args: Tuple, small_keys: Tuple[str, ...]):
+                 fmeta_args: Tuple, small_keys: Tuple[str, ...],
+                 quant_seed: int = 0, quant_hess_const: bool = False):
         if mode not in SWEEP_MODES:
             raise ValueError(f"unknown sweep mode {mode!r}")
         self.cfg = cfg
@@ -93,6 +94,15 @@ class SweepGrower:
         self.bag_freq = max(1, int(bag_freq))
         self.fmeta_args = tuple(fmeta_args)
         self.small_keys = tuple(small_keys)
+        # quantized-gradient training (cfg.hist_quantize != "none"): the
+        # rounding-key base seed and the constant-hessian flag are SHARED
+        # statics — "data_random_seed" is not sweep-variable and the
+        # boosting mode/objective decide hess_const, all of which every
+        # sweep member must agree on. That sharing is what keeps model k
+        # byte-identical to its solo quantized train: both derive keys
+        # from fold_in(fold_in(fold_in(PRNGKey(seed), it), class), 0|1)
+        self.quant_seed = int(quant_seed)
+        self.quant_hess_const = bool(quant_hess_const)
         # objective row arrays ride as ARGUMENTS, not closure captures
         # (a captured [N] array inlines into the lowered module as a
         # giant literal and defeats the persistent compile cache) — the
@@ -157,12 +167,38 @@ class SweepGrower:
             h = h.reshape(kc, n_pad)
             w = self._row_weight(it, pm_k, g, h, base_w)
 
-            def one_class(gc, hc, mc):
-                return grow_tree(binned, gc, hc, w, mc, *self.fmeta_args,
-                                 cfg, n_valid=jnp.int32(self.n),
-                                 gp=pm_k.grow)
+            if cfg.hist_quantize != "none":
+                # quantized-gradient mode: per-class integer codes with
+                # the solo path's exact key chain (gbdt.
+                # _quantize_iter_device) — shared across models, so the
+                # draw inside quantize_gradients stays the serial (n,)
+                # shape under BOTH the class vmap and the model vmap
+                from ..ops.histogram import quantize_gradients
+                base = jax.random.fold_in(
+                    jax.random.PRNGKey(self.quant_seed), it)
 
-            state = jax.vmap(one_class)(g, h, fmask_k)
+                def one_class_q(gc, hc, mc, ci):
+                    kq = jax.random.fold_in(base, ci)
+                    q_g, q_h, w01, qs = quantize_gradients(
+                        gc, hc, w, n=self.n, qmax=cfg.hist_qmax,
+                        key_g=jax.random.fold_in(kq, 0),
+                        key_h=jax.random.fold_in(kq, 1),
+                        hess_const=self.quant_hess_const)
+                    return grow_tree(binned, q_g, q_h, w01, mc,
+                                     *self.fmeta_args, cfg,
+                                     n_valid=jnp.int32(self.n),
+                                     gp=pm_k.grow, qscale=qs)
+
+                state = jax.vmap(one_class_q)(
+                    g, h, fmask_k, jnp.arange(kc, dtype=jnp.int32))
+            else:
+                def one_class(gc, hc, mc):
+                    return grow_tree(binned, gc, hc, w, mc,
+                                     *self.fmeta_args, cfg,
+                                     n_valid=jnp.int32(self.n),
+                                     gp=pm_k.grow)
+
+                state = jax.vmap(one_class)(g, h, fmask_k)
 
             def upd(lv, lid, grew):
                 vals = lv * pm_k.shrinkage

@@ -56,6 +56,106 @@ def _hi_lo(w):
     return hi, lo
 
 
+# ---------------------------------------------------------------------------
+# quantized-gradient training (tpu_hist_quantize, ISSUE 20)
+#
+# Per-iteration grad/hess vectors are scaled and stochastically rounded to
+# integers in [-qmax, qmax] (quantize_gradients below); the kernels then
+# contract the integer-valued channels exactly — int8 rides the plain
+# 3-channel bf16 contraction (every |v| <= 127 is bf16-exact and a chunk's
+# per-bin sum stays under 2^24, the bf16-einsum f32 accumulator's exact
+# range), int16 splits each value into base-256 digits hi*256 + lo with
+# |digit| <= 128 (the _hi_lo layout, reused with exact integer digits
+# instead of lossy bf16 halves). Cross-chunk accumulation is int32, so
+# histogram merges — psum/psum_scatter, sibling subtraction, compaction —
+# are associative-exact: any reduction order gives the same bits, which is
+# what keeps scatter == serial bitwise in the quantized modes.
+# ---------------------------------------------------------------------------
+
+TRAIN_QUANTIZE_MODES = ("none", "int16", "int8")
+
+_TRAIN_QMAX = {"int8": 127, "int16": 32767}
+
+
+def train_qmax(mode: str, n: int) -> int:
+    """Adaptive clip magnitude for quantized training at row count n.
+
+    The int32 bin accumulators must absorb a worst-case bin holding every
+    row at full magnitude: |sum q| <= qmax * n must stay below 2^31. The
+    256 headroom additionally covers the int16 digit channels' worst-case
+    carry (256 * sum hi <= sum|q| + 128n, and once the cap forces
+    qmax < 128 the hi digit is identically zero). Small datasets get the
+    full type range; huge ones degrade precision gracefully — the
+    accuracy gate (gbdt._hist_quant_gate) judges whether the surviving
+    precision is acceptable."""
+    cap = (2 ** 31 - 1) // max(1, int(n)) - 256
+    return max(1, min(_TRAIN_QMAX[mode], cap))
+
+
+def _digits(w):
+    """Split integer-valued f32 (|w| <= 32767) into base-256 digits:
+    w == hi * 256 + lo with both digits integer-valued in [-128, 128] —
+    every digit is bf16-exact, so the bf16 einsum contracts them with
+    zero rounding error."""
+    hi = jnp.round(w * (1.0 / 256.0))
+    lo = w - 256.0 * hi
+    return hi, lo
+
+
+def stochastic_round(x, key, n: int):
+    """Stochastically round f32 [n_pad] to integer-valued f32.
+
+    The uniform is drawn over the SERIAL shape (n,) and padded — a
+    (n_pad,) draw would tie the rounding to the padded row count (a
+    function of device count; threefry is not prefix-stable across
+    shapes) and break cross-world-size bit-identity, the PR 11 bagging
+    bug class. Padding rows carry x == 0 (zero channels contract to
+    zero), and floor(0) + (0 < 0) == 0 keeps them at zero."""
+    n_pad = x.shape[0]
+    u = jax.random.uniform(key, (n,))
+    if n_pad > n:
+        u = jnp.pad(u, (0, n_pad - n))
+    f = jnp.floor(x)
+    return f + (u < (x - f)).astype(jnp.float32)
+
+
+# scale floor: an all-zero gradient vector must not divide by zero; any
+# positive subnormal-free floor works (the quantized values are then 0)
+_SCALE_FLOOR = jnp.float32(1e-30)
+
+
+def quantize_gradients(grad, hess, row_weight, *, n: int, qmax: int,
+                       key_g, key_h, hess_const=False):
+    """Quantize one class's gradient/hessian vectors for histogram work.
+
+    Bagging/GOSS weights fold in BEFORE quantization (gw = grad * rw), so
+    amplified rows quantize at their amplified magnitude and the returned
+    row weight collapses to the 0/1 in-bag indicator — grow_tree's
+    channel build (q * w01) then keeps every channel integer-valued.
+
+    hess_const (python bool or traced scalar): with a constant hessian
+    and 0/1 row weights every in-bag row's hw is the same value, so the
+    deterministic q_h = qmax * w01 is EXACT (per-bin hess == qmax * count
+    in the integer domain — the identity the constant-hessian collective
+    elision in learner/grow.py relies on) and needs no rounding key.
+
+    Returns (q_g, q_h, w01, qscale): integer-valued f32 vectors in
+    [-qmax, qmax], the 0/1 in-bag weight, and the [3] dequantization
+    scale (g_scale, h_scale, 1.0) with q * scale ~= the real-unit value.
+    """
+    qm = jnp.float32(qmax)
+    w01 = (row_weight > 0).astype(jnp.float32)
+    gw = grad * row_weight
+    hw = hess * row_weight
+    g_scale = jnp.maximum(jnp.max(jnp.abs(gw[:n])), _SCALE_FLOOR) / qm
+    h_scale = jnp.maximum(jnp.max(jnp.abs(hw[:n])), _SCALE_FLOOR) / qm
+    q_g = jnp.clip(stochastic_round(gw / g_scale, key_g, n), -qm, qm)
+    q_h_sr = jnp.clip(stochastic_round(hw / h_scale, key_h, n), -qm, qm)
+    q_h = jnp.where(hess_const, qm * w01, q_h_sr)
+    qscale = jnp.stack([g_scale, h_scale, jnp.float32(1.0)])
+    return q_g, q_h, w01, qscale
+
+
 # one-hot working-set budget per (row-chunk x group-block) contraction step,
 # in elements; bounds the materialized [chunk, Gb, Bb] operand
 _BLOCK_BUDGET = 1 << 26
@@ -134,8 +234,8 @@ def _contract_blocks(binned, row0, chunk, blocks, num_bins, u, bf16):
         blocks, num_bins, u, bf16)
 
 
-def _blocks_zeros(blocks, num_bins, s):
-    return tuple(jnp.zeros((gc, min(bw, num_bins), s), jnp.float32)
+def _blocks_zeros(blocks, num_bins, s, dtype=jnp.float32):
+    return tuple(jnp.zeros((gc, min(bw, num_bins), s), dtype)
                  for _, gc, bw in blocks)
 
 
@@ -155,29 +255,85 @@ def _onehot(binned_chunk: jnp.ndarray, num_bins: int) -> jnp.ndarray:
             jnp.arange(num_bins, dtype=binned_chunk.dtype)[None, None, :])
 
 
-def _accumulate_chunks(one, n_chunks, blocks, num_bins, s, n_valid, chunk):
+def _accumulate_chunks(one, n_chunks, blocks, num_bins, s, n_valid, chunk,
+                       dtype=jnp.float32):
     """Shared chunk-accumulation scaffolding for both kernels: ragged
     per-block carries through the fori_loop, assembled (padded to the
-    uniform width) once at the end."""
+    uniform width) once at the end. Quantized modes carry int32 — each
+    chunk's f32 einsum output is exactly integer-valued (per-chunk sums
+    stay under 2^24), so the cast loses nothing and the cross-chunk sum
+    becomes order-invariant."""
+    def cast(parts):
+        if dtype == jnp.float32:
+            return parts
+        return tuple(p.astype(dtype) for p in parts)
+
     if n_chunks == 1:
-        return _assemble_blocks(one(jnp.int32(0)), num_bins)
+        return _assemble_blocks(cast(one(jnp.int32(0))), num_bins)
 
     def body(c, accs):
-        return tuple(a + p for a, p in zip(accs, one(c)))
+        return tuple(a + p for a, p in zip(accs, cast(one(c))))
 
     trip = n_chunks if n_valid is None else \
         jnp.minimum((n_valid + chunk - 1) // chunk, n_chunks)
-    init = _blocks_zeros(blocks, num_bins, s)
+    init = _blocks_zeros(blocks, num_bins, s, dtype)
     return _assemble_blocks(
         jax.lax.fori_loop(0, trip, body, init), num_bins)
 
 
+def _quant_s(quantize: str, c_ids: int = 1) -> int:
+    """Live channel count per id under a quantized mode: int8 contracts
+    (g, h, cnt) directly; int16 adds the two lo-digit channels in the
+    same slots the bf16 hi+lo layout uses."""
+    return c_ids * (5 if quantize == "int16" else 3)
+
+
+def _quant_u(w_chunk, quantize, member=None):
+    """Channel matrix for a quantized chunk, already bf16 (exact: every
+    entry is an integer of magnitude <= 128 for int16 digits, <= 127 for
+    int8). Layout matches the bf16 hi+lo path — [g_hi, h_hi, cnt,
+    g_lo, h_lo] per id for int16 (the count channel is a raw 0/1, never
+    digit-split), [g, h, cnt] for int8 — so the post-loop merge reuses
+    the same slot arithmetic with *256 instead of +."""
+    if quantize == "int16":
+        hi, lo = _digits(w_chunk[:, 0:2])
+        base = jnp.concatenate([hi, w_chunk[:, 2:3]], axis=1)
+    else:
+        base, lo = w_chunk, None
+    if member is None:
+        u = base if lo is None else jnp.concatenate([base, lo], axis=1)
+        return u.astype(jnp.bfloat16)
+    c_ids = member.shape[1]
+    mb = member[:, :, None].astype(jnp.bfloat16)
+    u = (mb * base.astype(jnp.bfloat16)[:, None, :]).reshape(-1, c_ids * 3)
+    if lo is not None:
+        u_lo = (mb[:, :, 0:2] * lo.astype(jnp.bfloat16)[:, None, :]
+                ).reshape(-1, c_ids * 2)
+        u = jnp.concatenate([u, u_lo], axis=1)
+    return u
+
+
+def _quant_merge(hist, quantize, f, num_bins, c_ids=None):
+    """Recombine int16 digit channels after the int32 accumulation:
+    value = hi * 256 + lo (exact in int32 — train_qmax caps the per-row
+    magnitude so the worst-case carry fits). int8 has no digit channels."""
+    if quantize != "int16":
+        return hist
+    if c_ids is None:
+        return hist[:, :, 0:3].at[:, :, 0:2].set(
+            hist[:, :, 0:2] * 256 + hist[:, :, 3:5])
+    main = hist[:, :, :c_ids * 3].reshape(f, num_bins, c_ids, 3)
+    corr = hist[:, :, c_ids * 3:].reshape(f, num_bins, c_ids, 2)
+    return (main.at[:, :, :, 0:2].set(main[:, :, :, 0:2] * 256 + corr)
+            .reshape(f, num_bins, c_ids * 3))
+
+
 @functools.partial(jax.jit, static_argnames=("num_bins", "chunk", "bf16",
-                                             "group_widths"))
+                                             "group_widths", "quantize"))
 def leaf_histogram(binned: jnp.ndarray, weights: jnp.ndarray,
                    num_bins: int, chunk: int = 16384,
                    bf16: bool = True, n_valid=None,
-                   group_widths=None) -> jnp.ndarray:
+                   group_widths=None, quantize: str = "none") -> jnp.ndarray:
     """hist[f, b, (g,h,cnt)] over rows where the mask channel is nonzero.
 
     Args:
@@ -194,6 +350,10 @@ def leaf_histogram(binned: jnp.ndarray, weights: jnp.ndarray,
       group_widths: optional static tuple of per-group bin counts; the
                group axis is then tiled into blocks each scanned at its
                own width (plan_group_blocks). None = uniform num_bins.
+      quantize: "none" (f32/bf16 hi+lo path), or "int16"/"int8" — the
+               weight channels must then be INTEGER-VALUED f32 in
+               [-train_qmax, train_qmax] (quantize_gradients); the
+               contraction is exact and the histogram returns int32.
 
     CONTRACT: padding rows must carry all-zero `weights` channels. n_valid
     only skips WHOLE trailing chunks; the partial boundary chunk (and the
@@ -201,19 +361,22 @@ def leaf_histogram(binned: jnp.ndarray, weights: jnp.ndarray,
     every row, so correctness relies on padded rows contributing zero to
     every (g, h, cnt) channel — not on the chunk-skip.
 
-    Returns: [F, B, 3] float32.
+    Returns: [F, B, 3] float32 (int32 when quantized).
     """
     n, f = binned.shape
     if n % chunk != 0:
         raise ValueError(f"rows ({n}) must be padded to a multiple of chunk ({chunk})")
+    q = quantize != "none"
     n_chunks = n // chunk
     widths = group_widths if group_widths else (num_bins,) * f
     blocks = plan_group_blocks(widths, chunk)
-    s = 5 if bf16 else 3
+    s = _quant_s(quantize) if q else (5 if bf16 else 3)
 
     def one(c):
         w_chunk = jax.lax.dynamic_slice(weights, (c * chunk, 0), (chunk, 3))
-        if bf16:
+        if q:
+            u = _quant_u(w_chunk, quantize)
+        elif bf16:
             hi, lo = _hi_lo(w_chunk)
             # count channel is 0/1 = bf16-exact, so only grad/hess need
             # the lo correction: S = 3 hi + 2 lo
@@ -221,10 +384,13 @@ def leaf_histogram(binned: jnp.ndarray, weights: jnp.ndarray,
         else:
             u = w_chunk
         return _contract_blocks(binned, c * chunk, chunk, blocks,
-                                num_bins, u, bf16)
+                                num_bins, u, bf16 or q)
 
     hist = _accumulate_chunks(one, n_chunks, blocks, num_bins, s,
-                              n_valid, chunk)
+                              n_valid, chunk,
+                              dtype=jnp.int32 if q else jnp.float32)
+    if q:
+        return _quant_merge(hist, quantize, f, num_bins)
     if bf16:
         hist = hist[:, :, 0:3].at[:, :, 0:2].add(hist[:, :, 3:5])
     return hist
@@ -232,12 +398,13 @@ def leaf_histogram(binned: jnp.ndarray, weights: jnp.ndarray,
 
 @functools.partial(jax.jit,
                    static_argnames=("num_bins", "chunk", "bf16",
-                                    "group_widths"))
+                                    "group_widths", "quantize"))
 def batched_leaves_histogram(binned: jnp.ndarray, weights: jnp.ndarray,
                              leaf_id: jnp.ndarray, ids: jnp.ndarray,
                              num_bins: int, chunk: int = 16384,
                              bf16: bool = True, n_valid=None,
-                             group_widths=None) -> jnp.ndarray:
+                             group_widths=None,
+                             quantize: str = "none") -> jnp.ndarray:
     """Histograms of C arbitrary leaf-label ids in one data pass.
 
     The speculative grower (learner/grow.py) relabels rows to child node
@@ -262,17 +429,21 @@ def batched_leaves_histogram(binned: jnp.ndarray, weights: jnp.ndarray,
     n, f = binned.shape
     if n % chunk != 0:
         raise ValueError(f"rows ({n}) must be padded to a multiple of chunk ({chunk})")
+    q = quantize != "none"
     c_ids = ids.shape[0]
     n_chunks = n // chunk
     widths = group_widths if group_widths else (num_bins,) * f
     blocks = plan_group_blocks(widths, chunk)
-    s = c_ids * 5 if bf16 else c_ids * 3
+    s = _quant_s(quantize, c_ids) if q else \
+        (c_ids * 5 if bf16 else c_ids * 3)
 
     def one(c):
         w_chunk = jax.lax.dynamic_slice(weights, (c * chunk, 0), (chunk, 3))
         lid = jax.lax.dynamic_slice(leaf_id, (c * chunk,), (chunk,))
         member = lid[:, None] == ids[None, :]                  # [C, K]
-        if bf16:
+        if q:
+            u = _quant_u(w_chunk, quantize, member)
+        elif bf16:
             hi, lo = _hi_lo(w_chunk)
             mb = member[:, :, None].astype(jnp.bfloat16)
             u_hi = (mb * hi[:, None, :]).reshape(chunk, c_ids * 3)
@@ -282,11 +453,14 @@ def batched_leaves_histogram(binned: jnp.ndarray, weights: jnp.ndarray,
             u = (member[:, :, None].astype(jnp.float32)
                  * w_chunk[:, None, :]).reshape(chunk, c_ids * 3)
         return _contract_blocks(binned, c * chunk, chunk, blocks,
-                                num_bins, u, bf16)
+                                num_bins, u, bf16 or q)
 
     hist = _accumulate_chunks(one, n_chunks, blocks, num_bins, s,
-                              n_valid, chunk)
-    if bf16:
+                              n_valid, chunk,
+                              dtype=jnp.int32 if q else jnp.float32)
+    if q:
+        hist = _quant_merge(hist, quantize, f, num_bins, c_ids)
+    elif bf16:
         main = hist[:, :, :c_ids * 3].reshape(f, num_bins, c_ids, 3)
         corr = hist[:, :, c_ids * 3:].reshape(f, num_bins, c_ids, 2)
         hist = (main.at[:, :, :, 0:2].add(corr)
@@ -296,13 +470,13 @@ def batched_leaves_histogram(binned: jnp.ndarray, weights: jnp.ndarray,
 
 @functools.partial(jax.jit,
                    static_argnames=("num_bins", "chunk", "bf16",
-                                    "group_widths"))
+                                    "group_widths", "quantize"))
 def gathered_leaves_histogram(binned: jnp.ndarray, weights: jnp.ndarray,
                               leaf_id: jnp.ndarray, rows: jnp.ndarray,
                               ids: jnp.ndarray, num_bins: int,
                               chunk: int = 16384, bf16: bool = True,
-                              n_valid=None,
-                              group_widths=None) -> jnp.ndarray:
+                              n_valid=None, group_widths=None,
+                              quantize: str = "none") -> jnp.ndarray:
     """batched_leaves_histogram over a COMPACTED row subset.
 
     `rows` is a fixed-capacity [cap] i32 buffer of row indices into
@@ -331,11 +505,13 @@ def gathered_leaves_histogram(binned: jnp.ndarray, weights: jnp.ndarray,
     if cap % chunk != 0:
         raise ValueError(
             f"row buffer ({cap}) must be a multiple of chunk ({chunk})")
+    q = quantize != "none"
     c_ids = ids.shape[0]
     n_chunks = cap // chunk
     widths = group_widths if group_widths else (num_bins,) * f
     blocks = plan_group_blocks(widths, chunk)
-    s = c_ids * 5 if bf16 else c_ids * 3
+    s = _quant_s(quantize, c_ids) if q else \
+        (c_ids * 5 if bf16 else c_ids * 3)
     nv = jnp.int32(cap) if n_valid is None else \
         jnp.minimum(jnp.asarray(n_valid, jnp.int32), cap)
 
@@ -346,7 +522,9 @@ def gathered_leaves_histogram(binned: jnp.ndarray, weights: jnp.ndarray,
         b_rows = binned[r]                                     # [chunk, F]
         member = (leaf_id[r][:, None] == ids[None, :]) \
             & live[:, None]                                    # [C, K]
-        if bf16:
+        if q:
+            u = _quant_u(w_chunk, quantize, member)
+        elif bf16:
             hi, lo = _hi_lo(w_chunk)
             mb = member[:, :, None].astype(jnp.bfloat16)
             u_hi = (mb * hi[:, None, :]).reshape(chunk, c_ids * 3)
@@ -359,11 +537,14 @@ def gathered_leaves_histogram(binned: jnp.ndarray, weights: jnp.ndarray,
         return _contract_block_parts(
             lambda gs, gc: jax.lax.slice_in_dim(b_rows, gs, gs + gc,
                                                 axis=1),
-            blocks, num_bins, u, bf16)
+            blocks, num_bins, u, bf16 or q)
 
     hist = _accumulate_chunks(one, n_chunks, blocks, num_bins, s,
-                              nv, chunk)
-    if bf16:
+                              nv, chunk,
+                              dtype=jnp.int32 if q else jnp.float32)
+    if q:
+        hist = _quant_merge(hist, quantize, f, num_bins, c_ids)
+    elif bf16:
         main = hist[:, :, :c_ids * 3].reshape(f, num_bins, c_ids, 3)
         corr = hist[:, :, c_ids * 3:].reshape(f, num_bins, c_ids, 2)
         hist = (main.at[:, :, :, 0:2].add(corr)

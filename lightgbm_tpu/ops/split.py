@@ -47,6 +47,24 @@ class SplitResult(NamedTuple):
     right_count: jnp.ndarray
 
 
+def dequantize_hist(hist: jnp.ndarray, qscale) -> jnp.ndarray:
+    """Quantized-training seam (tpu_hist_quantize): map an int32 bin
+    histogram back to real gradient units right before split scoring.
+
+    qscale is the [3] per-channel scale (g_scale, h_scale, 1.0) from
+    ops.histogram.quantize_gradients; it broadcasts over the trailing
+    (g, h, cnt) channel axis of any [..., 3] histogram/total. None is the
+    f32 path's no-op, so callers can thread an optional scale without
+    branching on mode. Everything downstream of this point — gains, leaf
+    outputs, min_sum_hessian constraints — sees ordinary f32 sums; the
+    exact integer domain ends here (the parent-sum identity
+    sum(left) + sum(right) == parent holds bitwise in int32, and both
+    sides dequantize through the SAME scale)."""
+    if qscale is None:
+        return hist
+    return hist.astype(jnp.float32) * qscale
+
+
 def leaf_split_gain(sum_g, sum_h, l1: float, l2: float):
     """Reference: GetLeafSplitGain, feature_histogram.hpp:206-212."""
     reg = jnp.maximum(jnp.abs(sum_g) - l1, 0.0)

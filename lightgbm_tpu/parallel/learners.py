@@ -161,7 +161,7 @@ class DataParallelGrower:
         return self._scatter_binned, self._owned_feats
 
     def __call__(self, binned, grad, hess, row_weight, feature_mask,
-                 fmeta: Dict, n_valid=None):
+                 fmeta: Dict, n_valid=None, qscale=None):
         # the per-pass dispatch is a host-level collective seam: under
         # multi-process training the global-row-array assembly below
         # blocks on every peer, and a dead/wedged rank would park this
@@ -173,10 +173,10 @@ class DataParallelGrower:
         with watchdog.deadline("collective.dispatch",
                                iteration=self._calls):
             return self._dispatch(binned, grad, hess, row_weight,
-                                  feature_mask, fmeta, n_valid)
+                                  feature_mask, fmeta, n_valid, qscale)
 
     def _dispatch(self, binned, grad, hess, row_weight, feature_mask,
-                  fmeta: Dict, n_valid=None):
+                  fmeta: Dict, n_valid=None, qscale=None):
         # injection point: a severed/restarting worker surfaces here as
         # a failed collective dispatch; a WEDGED worker surfaces as an
         # injected sleep the deadline guard above must catch
@@ -224,27 +224,54 @@ class DataParallelGrower:
         # row count, so one shard_map signature serves both
         if n_valid is None:
             n_valid = binned.shape[0]
+        # quantized-gradient mode: the [3] dequant scale rides replicated
+        # as an EXTRA trailing operand — the f32 dispatch keeps its
+        # existing signature (and compiled program) untouched
         if owned_feats is None:
+            if qscale is None:
+                run = shard_map_compat(
+                    lambda b, g, h, w, fm, nv, *meta: grow_tree(
+                        b, g, h, w, fm, *meta, cfg, n_valid=nv),
+                    mesh=self.mesh,
+                    in_specs=(P(ax, None), P(ax), P(ax), P(ax), P(None),
+                              P()) + (P(None),) * 7,
+                    out_specs=state_spec)
+                return run(binned, grad, hess, row_weight, feature_mask,
+                           jnp.int32(n_valid),
+                           *[fmeta[k] for k in FMETA_KEYS])
             run = shard_map_compat(
-                lambda b, g, h, w, fm, nv, *meta: grow_tree(
-                    b, g, h, w, fm, *meta, cfg, n_valid=nv),
+                lambda b, g, h, w, fm, nv, qs, *meta: grow_tree(
+                    b, g, h, w, fm, *meta, cfg, n_valid=nv, qscale=qs),
                 mesh=self.mesh,
-                in_specs=(P(ax, None), P(ax), P(ax), P(ax), P(None), P())
-                         + (P(None),) * 7,
+                in_specs=(P(ax, None), P(ax), P(ax), P(ax), P(None), P(),
+                          P(None)) + (P(None),) * 7,
                 out_specs=state_spec)
             return run(binned, grad, hess, row_weight, feature_mask,
-                       jnp.int32(n_valid), *[fmeta[k] for k in FMETA_KEYS])
+                       jnp.int32(n_valid), qscale,
+                       *[fmeta[k] for k in FMETA_KEYS])
         # scatter schedule: the owned-feature table rides replicated and
         # each shard dynamic-indexes its own row (multihost-safe)
+        if qscale is None:
+            run = shard_map_compat(
+                lambda b, g, h, w, fm, nv, of, *meta: grow_tree(
+                    b, g, h, w, fm, *meta, cfg, n_valid=nv, owned_feats=of),
+                mesh=self.mesh,
+                in_specs=(P(ax, None), P(ax), P(ax), P(ax), P(None), P(),
+                          P(None, None)) + (P(None),) * 7,
+                out_specs=state_spec)
+            return run(binned, grad, hess, row_weight, feature_mask,
+                       jnp.int32(n_valid), owned_feats,
+                       *[fmeta[k] for k in FMETA_KEYS])
         run = shard_map_compat(
-            lambda b, g, h, w, fm, nv, of, *meta: grow_tree(
-                b, g, h, w, fm, *meta, cfg, n_valid=nv, owned_feats=of),
+            lambda b, g, h, w, fm, nv, of, qs, *meta: grow_tree(
+                b, g, h, w, fm, *meta, cfg, n_valid=nv, owned_feats=of,
+                qscale=qs),
             mesh=self.mesh,
             in_specs=(P(ax, None), P(ax), P(ax), P(ax), P(None), P(),
-                      P(None, None)) + (P(None),) * 7,
+                      P(None, None), P(None)) + (P(None),) * 7,
             out_specs=state_spec)
         return run(binned, grad, hess, row_weight, feature_mask,
-                   jnp.int32(n_valid), owned_feats,
+                   jnp.int32(n_valid), owned_feats, qscale,
                    *[fmeta[k] for k in FMETA_KEYS])
 
     def _state_specs(self):
@@ -289,15 +316,15 @@ class FeatureParallelGrower:
         return binned, fmeta
 
     def __call__(self, binned, grad, hess, row_weight, feature_mask, fmeta,
-                 n_valid=None):
+                 n_valid=None, qscale=None):
         self._calls = getattr(self, "_calls", 0) + 1
         with watchdog.deadline("collective.dispatch",
                                iteration=self._calls):
             return self._dispatch(binned, grad, hess, row_weight,
-                                  feature_mask, fmeta, n_valid)
+                                  feature_mask, fmeta, n_valid, qscale)
 
     def _dispatch(self, binned, grad, hess, row_weight, feature_mask, fmeta,
-                  n_valid=None):
+                  n_valid=None, qscale=None):
         faults.inject("collective.call")
         telemetry.heartbeat(self._calls, phase="grower_dispatch")
         telemetry.counter_add("parallel/grower_calls", 1)
@@ -308,15 +335,26 @@ class FeatureParallelGrower:
         state_spec = TreeGrowerState(**fields)
         if n_valid is None:
             n_valid = binned.shape[0]
+        if qscale is None:
+            run = shard_map_compat(
+                lambda b, g, h, w, fm, nv, *meta: grow_tree(
+                    b, g, h, w, fm, *meta, cfg, n_valid=nv),
+                mesh=self.mesh,
+                in_specs=(P(None, None), P(None), P(None), P(None), P(None),
+                          P()) + (P(None),) * 7,
+                out_specs=state_spec)
+            return run(binned, grad, hess, row_weight, feature_mask,
+                       jnp.int32(n_valid), *[fmeta[k] for k in FMETA_KEYS])
         run = shard_map_compat(
-            lambda b, g, h, w, fm, nv, *meta: grow_tree(
-                b, g, h, w, fm, *meta, cfg, n_valid=nv),
+            lambda b, g, h, w, fm, nv, qs, *meta: grow_tree(
+                b, g, h, w, fm, *meta, cfg, n_valid=nv, qscale=qs),
             mesh=self.mesh,
             in_specs=(P(None, None), P(None), P(None), P(None), P(None),
-                      P()) + (P(None),) * 7,
+                      P(), P(None)) + (P(None),) * 7,
             out_specs=state_spec)
         return run(binned, grad, hess, row_weight, feature_mask,
-                   jnp.int32(n_valid), *[fmeta[k] for k in FMETA_KEYS])
+                   jnp.int32(n_valid), qscale,
+                   *[fmeta[k] for k in FMETA_KEYS])
 
 
 class VotingParallelGrower(DataParallelGrower):

@@ -275,6 +275,7 @@ class TrainRecorder:
             "table_high_water": max(int(p[1]) for p in new),
             "rows_contracted": sum(float(p[2]) for p in new if len(p) > 2),
             "comm_elems": sum(float(p[3]) for p in new if len(p) > 3),
+            "comm_bytes": sum(float(p[4]) for p in new if len(p) > 4),
         }
 
     # -- record emission --------------------------------------------------

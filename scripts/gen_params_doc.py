@@ -292,6 +292,27 @@ DESCRIPTIONS = {
     "tpu_hist_pallas": "retired; accepted for compatibility, warns and "
                        "uses the XLA path (see profiles/README.md "
                        "postmortem)",
+    "tpu_hist_quantize": "quantized-gradient training: none (default) "
+                         "= bit-exact f32 histogram path; int16/int8 = "
+                         "per-iteration gradients/hessians scaled and "
+                         "stochastically rounded to narrow integer "
+                         "codes (deterministic per-(seed, iteration, "
+                         "class) keys), histograms accumulated in the "
+                         "exact int32 domain — scatter/allreduce/"
+                         "sibling-subtraction merges stay bitwise "
+                         "schedule-invariant — and dequantized once at "
+                         "the split-scoring seam. int8 also widens the "
+                         "leaf batch per pass (3 channels vs 5 in the "
+                         "same 128-lane tile). Refused under "
+                         "multi-process training",
+    "tpu_hist_quantize_tol": "train-time accuracy gate for quantized "
+                             "histograms: at setup one calibration "
+                             "tree is grown with the quantized "
+                             "pipeline and one with f32; the config "
+                             "is refused with an error when the max "
+                             "per-row leaf-value delta (relative to "
+                             "the f32 tree's leaf-value scale) "
+                             "exceeds this tolerance",
     # boosting
     "num_iterations": "boosting rounds",
     "learning_rate": "shrinkage applied to each tree",

@@ -1,0 +1,24 @@
+"""POSITIVE fixture: quantized-training stochastic rounding keyed over
+padded/bucketed row counts (ISSUE 20).
+
+The rounding uniform decides each gradient code's up/down tie-break. A
+draw shaped by the padded row count makes every code — and through the
+histogram, every split — a function of the device count; a draw shaped
+by a row-count BUCKET ties the codes to the loader's bucket ladder.
+Both break the quantized modes' cross-world-size bit-identity the same
+way the PR 11 bagging mask did.
+"""
+import jax
+import jax.numpy as jnp
+
+
+def stochastic_round_padded(x, key, n_pad):
+    u = jax.random.uniform(key, (n_pad,))
+    f = jnp.floor(x)
+    return f + (u < (x - f)).astype(jnp.float32)
+
+
+def stochastic_round_bucketed(x, key, bucket_rows):
+    u = jax.random.uniform(key, shape=(bucket_rows,))
+    f = jnp.floor(x)
+    return f + (u < (x - f)).astype(jnp.float32)

@@ -1,0 +1,16 @@
+"""NEGATIVE fixture: the quantized-training rounding idiom (ISSUE 20,
+ops/histogram.stochastic_round) — the uniform is drawn at the SERIAL
+extent (n,) and the RESULT is padded, so every gradient code is a pure
+function of (seed, iteration, n) at any world size. The padded
+identifier appears only outside the sampling call's argument list, in
+the pad of the result."""
+import jax
+import jax.numpy as jnp
+
+
+def stochastic_round(x, key, n, n_pad):
+    u = jax.random.uniform(key, (n,))
+    if n_pad > n:
+        u = jnp.pad(u, (0, n_pad - n))
+    f = jnp.floor(x)
+    return f + (u < (x - f)).astype(jnp.float32)

@@ -1,0 +1,316 @@
+"""Quantized-gradient training (ISSUE 20 tentpole).
+
+Contracts under test:
+
+- `tpu_hist_quantize=none` (the default) is BYTE-IDENTICAL to training
+  with the parameter unset — the f32 path's traced graph is untouched.
+- Quantized modes are deterministic: the stochastic-rounding keys are
+  derived per (data_random_seed, iteration, class), so the same config
+  trains the same model twice.
+- At the grower level the quantized schedules are bitwise
+  schedule-invariant: serial grow_tree == DataParallelGrower allreduce
+  == scatter on EVERY output field, because the histogram domain is
+  exact int32 (summation order cannot matter) and dequantization
+  happens once, at the split-scoring seam, on identical totals.
+  (Multi-round serial-learner vs data-learner full-train equality is
+  NOT a property even at f32 — the score-update paths differ — so the
+  cross-learner contract is pinned here, like tests/test_scatter_reduce.)
+- Model k of a quantized sweep == its solo quantized train.
+- The train-time accuracy gate refuses an over-tight tolerance with a
+  LightGBMError naming `tpu_hist_quantize_tol`.
+- linear_tree composes with quantized histograms: split finding uses
+  the codes, the leaf regressions consume the raw f32 moments.
+"""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.basic import LightGBMError
+from lightgbm_tpu.engine import train, train_sweep
+from lightgbm_tpu.ops.histogram import (TRAIN_QUANTIZE_MODES,
+                                        quantize_gradients, train_qmax)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+BASE = dict(objective="binary", num_leaves=15, max_bin=63, verbosity=-1,
+            min_data_in_leaf=5, learning_rate=0.15, seed=7)
+
+
+@pytest.fixture(scope="module")
+def binary_data():
+    rng = np.random.RandomState(3)
+    n = 900
+    X = np.asarray(rng.randn(n, 10), np.float32)
+    y = (X[:, 0] + 0.4 * X[:, 1] ** 2 + 0.3 * rng.randn(n)
+         > 0.3).astype(np.float32)
+    return X, y
+
+
+def _model_text(params, X, y, rounds=8):
+    return train(dict(params), lgb.Dataset(X, y),
+                 num_boost_round=rounds).model_to_string()
+
+
+# ---------------------------------------------------------------------------
+# quantizer unit properties
+# ---------------------------------------------------------------------------
+def test_train_qmax_bounds():
+    """qmax is the type max for small n, shrinks to keep n*qmax (plus
+    int16 digit-carry headroom) inside int32, and never drops below 1."""
+    assert train_qmax("int8", 1000) == 127
+    assert train_qmax("int16", 1000) == 32767
+    big = 2 ** 27
+    for mode in ("int8", "int16"):
+        q = train_qmax(mode, big)
+        assert 1 <= q <= {"int8": 127, "int16": 32767}[mode]
+        assert big * q <= 2 ** 31 - 1
+    assert train_qmax("int8", 2 ** 31) == 1
+
+
+def test_quantize_gradients_codes_are_bounded_integers():
+    import jax
+    import jax.numpy as jnp
+    rng = np.random.RandomState(0)
+    n, n_pad = 500, 512
+    g = np.zeros(n_pad, np.float32)
+    h = np.zeros(n_pad, np.float32)
+    g[:n] = rng.randn(n).astype(np.float32) * 3.0
+    h[:n] = rng.rand(n).astype(np.float32) + 0.1
+    rw = np.ones(n_pad, np.float32)
+    rw[n:] = 0.0
+    rw[::7] = 0.0  # bagged-out rows
+    qmax = train_qmax("int8", n)
+    key = jax.random.PRNGKey(11)
+    q_g, q_h, w01, qs = quantize_gradients(
+        jnp.asarray(g), jnp.asarray(h), jnp.asarray(rw), n=n, qmax=qmax,
+        key_g=jax.random.fold_in(key, 0), key_h=jax.random.fold_in(key, 1),
+        hess_const=False)
+    for q in (np.asarray(q_g), np.asarray(q_h)):
+        assert np.array_equal(q, np.round(q)), "codes must be integers"
+        assert np.abs(q).max() <= qmax
+        assert np.all(q[n:] == 0.0), "padded tail must stay zero"
+    assert np.array_equal(np.asarray(w01), (rw > 0).astype(np.float32))
+    qs = np.asarray(qs)
+    assert qs.shape == (3,) and qs[2] == 1.0 and qs[0] > 0 and qs[1] > 0
+    # weight folding: rows bagged out quantize to 0 exactly
+    assert np.all(np.asarray(q_g)[rw == 0.0] == 0.0)
+
+
+def test_quantize_gradients_constant_hessian_is_exact():
+    import jax
+    import jax.numpy as jnp
+    n, n_pad = 300, 320
+    g = np.linspace(-1, 1, n_pad).astype(np.float32)
+    h = np.ones(n_pad, np.float32)
+    rw = (np.arange(n_pad) % 3 != 0).astype(np.float32)
+    rw[n:] = 0.0
+    qmax = train_qmax("int16", n)
+    key = jax.random.PRNGKey(5)
+    _, q_h, w01, _ = quantize_gradients(
+        jnp.asarray(g), jnp.asarray(h), jnp.asarray(rw), n=n, qmax=qmax,
+        key_g=jax.random.fold_in(key, 0), key_h=jax.random.fold_in(key, 1),
+        hess_const=True)
+    # the constant-hessian branch carries NO rounding noise: the code is
+    # exactly qmax * in_bag, which is what lets the grower elide the
+    # hess channel from the scatter collective
+    assert np.array_equal(np.asarray(q_h), qmax * np.asarray(w01))
+
+
+# ---------------------------------------------------------------------------
+# none == unset, determinism, gate, linear_tree
+# ---------------------------------------------------------------------------
+def test_none_mode_byte_identical_to_unset(binary_data):
+    X, y = binary_data
+    for extra in (dict(), dict(bagging_fraction=0.7, bagging_freq=1,
+                               bagging_seed=9)):
+        ref = _model_text(dict(BASE, **extra), X, y)
+        none = _model_text(dict(BASE, tpu_hist_quantize="none", **extra),
+                           X, y)
+        assert none == ref, f"none-mode drift under {extra or 'plain'}"
+
+
+@pytest.mark.parametrize("mode", ["int16", "int8"])
+def test_quantized_training_deterministic(binary_data, mode):
+    X, y = binary_data
+    params = dict(BASE, tpu_hist_quantize=mode, tpu_hist_quantize_tol=10.0)
+    a = _model_text(params, X, y, rounds=6)
+    b = _model_text(params, X, y, rounds=6)
+    assert a == b
+    # and it genuinely trained a multi-leaf forest
+    assert a.count("split_gain") >= 6
+
+
+def test_invalid_mode_refused(binary_data):
+    X, y = binary_data
+    with pytest.raises(LightGBMError, match="tpu_hist_quantize"):
+        train(dict(BASE, tpu_hist_quantize="int4"), lgb.Dataset(X, y),
+              num_boost_round=2)
+
+
+def test_gate_refuses_overtight_tolerance(binary_data):
+    """tol=1e-12 is below any real stochastic-rounding delta: the
+    calibration gate must refuse BY NAME instead of training lossily.
+    Regression objective: its iteration-0 gradients are CONTINUOUS
+    (-residuals), so int8 codes carry genuine rounding noise. (Binary's
+    iteration-0 gradients take only two values, which narrow codes can
+    represent exactly — the gate rightly passes those.)"""
+    X, y = binary_data
+    yr = (X[:, 0] + 0.25 * X[:, 2]).astype(np.float32)
+    with pytest.raises(LightGBMError, match="tpu_hist_quantize_tol"):
+        train(dict(BASE, objective="regression", tpu_hist_quantize="int8",
+                   tpu_hist_quantize_tol=1e-12),
+              lgb.Dataset(X, yr), num_boost_round=2)
+
+
+def test_quantized_accuracy_near_f32(binary_data):
+    """int16 codes carry ~15 bits of gradient mantissa: train accuracy
+    must land within a small delta of the f32 run (the bench gate's
+    accuracy-delta column, in miniature)."""
+    X, y = binary_data
+
+    def acc(params):
+        booster = train(dict(params), lgb.Dataset(X, y),
+                        num_boost_round=20)
+        return float(((np.asarray(booster.predict(X)) > 0.5)
+                      == y.astype(bool)).mean())
+
+    a_f32 = acc(BASE)
+    a_q = acc(dict(BASE, tpu_hist_quantize="int16",
+                   tpu_hist_quantize_tol=10.0))
+    assert abs(a_f32 - a_q) < 0.02, (a_f32, a_q)
+
+
+def test_linear_tree_quantized_trains(binary_data):
+    """linear_tree + quantized: splits from codes, leaf regressions from
+    the RAW f32 moments — must train and produce linear leaves."""
+    X, y = binary_data
+    booster = train(dict(BASE, linear_tree=True, tpu_hist_quantize="int16",
+                         tpu_hist_quantize_tol=10.0),
+                    lgb.Dataset(X, y, params={"keep_raw": True}),
+                    num_boost_round=5)
+    text = booster.model_to_string()
+    assert "leaf_coeff" in text or "leaf_const" in text
+    p = np.asarray(booster.predict(X))
+    assert np.isfinite(p).all()
+
+
+def test_sweep_model_matches_solo_quantized(binary_data):
+    """Sweep bit-identity extends to quantized mode: the rounding-key
+    stream is derived from the sweep-SHARED data_random_seed, so model k
+    sees the serial path's exact keys."""
+    X, y = binary_data
+    plist = [dict(BASE, tpu_hist_quantize="int16",
+                  tpu_hist_quantize_tol=10.0, learning_rate=0.1,
+                  bagging_freq=1),
+             dict(BASE, tpu_hist_quantize="int16",
+                  tpu_hist_quantize_tol=10.0, learning_rate=0.2,
+                  bagging_fraction=0.8, bagging_freq=1, bagging_seed=4)]
+    sweep = train_sweep([dict(p) for p in plist], lgb.Dataset(X, y),
+                        num_boost_round=5)
+    for k, p in enumerate(plist):
+        solo = train(dict(p), lgb.Dataset(X, y), num_boost_round=5)
+        assert sweep[k].model_to_string() == solo.model_to_string(), \
+            f"quantized sweep model {k} diverged from solo"
+
+
+# ---------------------------------------------------------------------------
+# grower-level cross-learner bit-identity (subprocess: forced devices)
+# ---------------------------------------------------------------------------
+QUANT_SWEEP_CHILD = r"""
+import sys
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, {repo!r})
+import jax.numpy as jnp
+from lightgbm_tpu.learner.grow import GrowerConfig, grow_tree, FMETA_KEYS
+from lightgbm_tpu.ops.histogram import quantize_gradients, train_qmax
+from lightgbm_tpu.parallel import DataParallelGrower, make_mesh
+
+ndev = int(sys.argv[1])
+assert len(jax.devices()) >= ndev, (len(jax.devices()), ndev)
+
+N, F, B, L = 768, 6, 31, 15
+rng = np.random.RandomState(0)
+binned = (rng.rand(N, F) * B * rng.rand(F)[None, :]).astype(np.uint8) % B
+grad = (binned[:, 0] / 16.0 - 0.9 + 0.3 * rng.randn(N)).astype(np.float32)
+hess = (0.5 + 0.5 * rng.rand(N)).astype(np.float32)
+bag = (rng.rand(N) < 0.7).astype(np.float32)
+fmeta = {{
+    "num_bin": np.full(F, B, np.int32),
+    "missing_type": np.zeros(F, np.int32),
+    "default_bin": np.zeros(F, np.int32),
+    "is_categorical": np.zeros(F, bool),
+    "group": np.arange(F, dtype=np.int32),
+    "offset": np.zeros(F, np.int32),
+    "is_bundled": np.zeros(F, bool),
+}}
+fmj = {{k: jnp.asarray(v) for k, v in fmeta.items()}}
+base = dict(num_leaves=L, max_bins=B, chunk=64, lambda_l1=0.0,
+            lambda_l2=0.0, min_gain_to_split=0.0, min_data_in_leaf=2,
+            min_sum_hessian_in_leaf=1e-3, max_depth=-1)
+for mode in ("int16", "int8"):
+    qmax = train_qmax(mode, N)
+    for wname, rw in (("plain", np.ones(N, np.float32)), ("bag", bag)):
+        key = jax.random.PRNGKey(17)
+        q_g, q_h, w01, qs = quantize_gradients(
+            jnp.asarray(grad), jnp.asarray(hess), jnp.asarray(rw),
+            n=N, qmax=qmax, key_g=jax.random.fold_in(key, 0),
+            key_h=jax.random.fold_in(key, 1), hess_const=False)
+        for sub in (False, True):
+            cfg = GrowerConfig(**dict(base, hist_subtract=sub,
+                                      hist_quantize=mode, hist_qmax=qmax))
+            serial = grow_tree(jnp.asarray(binned), q_g, q_h, w01,
+                               jnp.ones(F, bool),
+                               *[fmj[k] for k in FMETA_KEYS], cfg,
+                               qscale=qs)
+            states = {{}}
+            for red in ("allreduce", "scatter"):
+                mesh = make_mesh(num_devices=ndev, axis_name="data")
+                grower = DataParallelGrower(mesh, cfg, axis="data",
+                                            hist_reduce=red)
+                states[red] = grower(jnp.asarray(binned), q_g, q_h, w01,
+                                     jnp.ones(F, bool), fmeta, qscale=qs)
+            a, s = states["allreduce"], states["scatter"]
+            tag = f"{{mode}}:{{wname}}:sub{{int(sub)}}"
+            # int32-exact histograms: EVERY field bitwise identical
+            # across serial / allreduce / scatter (comm accounting aside)
+            for k in a._fields:
+                if k == "comm_elems":
+                    continue
+                np.testing.assert_array_equal(
+                    np.asarray(getattr(a, k)), np.asarray(getattr(s, k)),
+                    err_msg=f"{{tag}}:{{k}} allreduce!=scatter")
+                np.testing.assert_array_equal(
+                    np.asarray(getattr(serial, k)),
+                    np.asarray(getattr(s, k)),
+                    err_msg=f"{{tag}}:{{k}} serial!=scatter")
+            assert int(s.num_leaves_used) > 2, tag
+            assert float(a.comm_elems) > float(s.comm_elems), tag
+            print(tag, "OK")
+print("QUANT_SWEEP_OK", ndev)
+"""
+
+
+@pytest.mark.parametrize("ndev", [4])
+def test_quantized_scatter_bitidentical_to_serial(ndev):
+    """serial grow_tree == allreduce == scatter, bitwise on EVERY grower
+    output (leaf values included — dequantization sees identical int32
+    totals), for int16/int8 x plain/bagged x subtraction on/off."""
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + f" --xla_force_host_platform_device_count={ndev}"
+                        ).strip()
+    env["JAX_PLATFORMS"] = "cpu"
+    res = subprocess.run(
+        [sys.executable, "-c", QUANT_SWEEP_CHILD.format(repo=REPO),
+         str(ndev)],
+        env=env, capture_output=True, text=True, timeout=420)
+    assert res.returncode == 0, \
+        f"{ndev}-device quantized sweep failed:\n{res.stdout}\n{res.stderr}"
+    assert f"QUANT_SWEEP_OK {ndev}" in res.stdout

@@ -132,6 +132,23 @@ def test_model_axis_padded_rng_fixture():
     assert not clean.findings
 
 
+def test_quant_round_padded_rng_fixture():
+    """The padded-rng invariant covers the quantized-training
+    stochastic-rounding keys (ISSUE 20): rounding uniforms shaped by
+    padded or bucketed row counts must be flagged; the serial
+    (n,)-draw-then-pad quantizer idiom (ops/histogram.stochastic_round)
+    must stay clean."""
+    report = _rule_report("padded-rng", "padded_rng",
+                          "bad_quant_round_padded.py")
+    assert len(report.findings) == 2  # positional padded + shape= bucket
+    msgs = [f.message for f in report.findings]
+    assert any("n_pad" in m for m in msgs)
+    assert any("bucket_rows" in m for m in msgs)
+    clean = _rule_report("padded-rng", "padded_rng",
+                         "good_quant_round_serial.py")
+    assert not clean.findings
+
+
 def test_config_hygiene_clean_tree_is_clean():
     report = _rule_report("config-hygiene", "config_hygiene", "good")
     assert not report.findings
